@@ -1,0 +1,26 @@
+"""deeplearning_cfn_tpu — a TPU-native distributed training framework.
+
+A from-scratch rebuild of the capabilities of ``armandmcqueen/deeplearning-cfn``
+(an EC2 CloudFormation cluster launcher + bundled Horovod/NCCL and MXNet-KVStore
+distributed training workloads), redesigned TPU-first:
+
+- The CloudFormation master/worker AutoScaling template (reference:
+  ``cfn-template/deeplearning.template``) becomes an in-tree TPU-VM pod-slice
+  provisioner (:mod:`deeplearning_cfn_tpu.provision`).
+- The cfn-bootstrap / SSH-mesh / hostfile cluster assembly becomes a multi-host
+  TPU runtime bootstrap (:mod:`deeplearning_cfn_tpu.runtime`) — slice hosts
+  already know their topology, so the reference's whole L1 layer collapses into
+  ``distributed.initialize`` + metadata discovery.
+- Horovod/NCCL allreduce and MXNet KVStore push/pull become XLA collectives
+  over ICI, scheduled by the compiler inside one ``jit``-compiled train step
+  (:mod:`deeplearning_cfn_tpu.parallel`, :mod:`deeplearning_cfn_tpu.train`).
+- The bundled workloads (CIFAR-10 ResNet-20, ImageNet ResNet-50, BERT-base
+  pretraining, Mask R-CNN COCO, Transformer NMT) are rebuilt as JAX/Flax
+  models + sharded training loops (:mod:`deeplearning_cfn_tpu.models`).
+- The ``stack create → train`` CLI flow is kept identical
+  (:mod:`deeplearning_cfn_tpu.cli`), with ``--accelerator=tpu``.
+
+See SURVEY.md at the repo root for the layer-by-layer mapping.
+"""
+
+__version__ = "0.1.0"

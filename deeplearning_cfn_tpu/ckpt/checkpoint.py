@@ -1,0 +1,335 @@
+"""Sharded, atomic, optionally-async checkpointing (SURVEY.md §6).
+
+The reference checkpointed framework-natively (MXNet ``.params`` epoch saves,
+TF Saver) from rank 0 to the shared EFS mount so any node could resume. The
+TPU rebuild does it properly for sharded state:
+
+- every *process* writes only the array shards it owns (addressable shards)
+  as ``shards_p<K>.npz`` plus its own ``manifest_p<K>.json`` listing which
+  global index ranges those shards cover; process 0 additionally writes the
+  tree-level ``manifest.json`` (leaf names, shapes, dtypes);
+- commit is filesystem-only (NO device collective, so it is safe on a
+  background thread concurrent with training collectives): each process
+  drops a ``DONE_p<K>`` marker after its files are durable, and process 0
+  writes ``COMMIT`` only once all markers exist — partial checkpoints are
+  never visible, the atomicity EFS + rank-0-saves never guaranteed;
+- restore merges every process's manifest, reassembles global arrays, and
+  places them with the *current* mesh's shardings, so a checkpoint taken on
+  one topology restores onto another (resize-via-resume, §4.5 — TPU slices
+  are not elastic, so this IS the scaling story);
+- async mode hands the host-side file write to a background thread after the
+  device→host copy, overlapping with the next training steps.
+
+Format: ``<dir>/step_<N>/{manifest.json, manifest_p<K>.json,
+shards_p<K>.npz, DONE_p<K>, COMMIT}``.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..utils.trees import flatten_with_names
+
+PyTree = Any
+
+_MANIFEST = "manifest.json"
+_COMMIT = "COMMIT"
+_DONE_TIMEOUT_S = 600.0
+
+
+# -- save -------------------------------------------------------------------
+
+
+def _local_shards(leaf) -> List[Tuple[Any, np.ndarray]]:
+    """Addressable (index, data) pairs for a (possibly distributed) array."""
+    if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+        out = []
+        seen = set()
+        for shard in leaf.addressable_shards:
+            key = tuple(
+                (s.start or 0, s.stop) for s in shard.index
+            ) if shard.index else ()
+            if key in seen:  # replicated across local devices: save once
+                continue
+            seen.add(key)
+            out.append((shard.index, np.asarray(shard.data)))
+        return out
+    return [((), np.asarray(leaf))]
+
+
+def _index_to_json(index, shape) -> List[List[int]]:
+    if index == ():
+        return [[0, int(s)] for s in shape]
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def save_checkpoint(
+    directory: str,
+    step: int,
+    state: PyTree,
+    keep: int = 0,
+    async_write: bool = False,
+    _thread_holder: Optional[List[threading.Thread]] = None,
+) -> str:
+    """Write one checkpoint. Multi-host safe; returns the checkpoint dir."""
+    ckpt_dir = os.path.join(directory, f"step_{step:08d}")
+    pidx = jax.process_index()
+    pcount = jax.process_count()
+    os.makedirs(ckpt_dir, exist_ok=True)
+
+    flat, _ = flatten_with_names(state)
+    # Device→host copy happens synchronously (HBM→RAM); the file write is
+    # what async mode defers to the background thread.
+    tree_manifest: Dict[str, Any] = {"step": step, "processes": pcount,
+                                     "leaves": {}}
+    proc_manifest: Dict[str, Any] = {"process": pidx, "leaves": {}}
+    arrays: Dict[str, np.ndarray] = {}
+    for name, leaf in flat:
+        if leaf is None:
+            tree_manifest["leaves"][name] = {"kind": "none"}
+            continue
+        shards = _local_shards(leaf)
+        shape = tuple(np.shape(leaf))
+        tree_manifest["leaves"][name] = {
+            "kind": "array", "shape": list(shape),
+            "dtype": str(np.asarray(shards[0][1]).dtype),
+        }
+        entries = []
+        for i, (index, data) in enumerate(shards):
+            key = f"{name}::{i}"
+            arrays[key] = data
+            entries.append({"key": key,
+                            "index": _index_to_json(index, shape)})
+        proc_manifest["leaves"][name] = entries
+
+    def write_files():
+        # 1. This process's shard file + manifest (atomic via rename).
+        shard_path = os.path.join(ckpt_dir, f"shards_p{pidx}.npz")
+        tmp = shard_path + ".tmp.npz"  # savez appends .npz unless present
+        np.savez(tmp, **arrays)
+        os.replace(tmp, shard_path)
+        with open(os.path.join(ckpt_dir, f"manifest_p{pidx}.json.tmp"),
+                  "w") as fh:
+            json.dump(proc_manifest, fh)
+        os.replace(os.path.join(ckpt_dir, f"manifest_p{pidx}.json.tmp"),
+                   os.path.join(ckpt_dir, f"manifest_p{pidx}.json"))
+        if pidx == 0:
+            with open(os.path.join(ckpt_dir, _MANIFEST), "w") as fh:
+                json.dump(tree_manifest, fh)
+        # 2. Marker, then filesystem-level commit rendezvous. No device
+        # collective here: a barrier on this thread could interleave with
+        # training collectives on the main thread and deadlock the pod.
+        with open(os.path.join(ckpt_dir, f"DONE_p{pidx}"), "w") as fh:
+            fh.write(str(step))
+        if pidx == 0:
+            deadline = time.time() + _DONE_TIMEOUT_S
+            while len(glob.glob(os.path.join(ckpt_dir, "DONE_p*"))) < pcount:
+                if time.time() > deadline:  # pragma: no cover
+                    print(f"[dlcfn-tpu] WARNING: checkpoint step {step} not "
+                          f"committed: missing DONE markers after "
+                          f"{_DONE_TIMEOUT_S}s")
+                    return
+                time.sleep(0.05)
+            with open(os.path.join(ckpt_dir, _COMMIT), "w") as fh:
+                fh.write(str(step))
+            if keep > 0:
+                _garbage_collect(directory, keep)
+
+    if async_write:
+        t = threading.Thread(target=write_files, daemon=True)
+        t.start()
+        if _thread_holder is not None:
+            _thread_holder.append(t)
+    else:
+        write_files()
+    return ckpt_dir
+
+
+def _garbage_collect(directory: str, keep: int):
+    steps = sorted(_committed_steps(directory))
+    for step in steps[:-keep]:
+        shutil.rmtree(os.path.join(directory, f"step_{step:08d}"),
+                      ignore_errors=True)
+
+
+# -- restore ----------------------------------------------------------------
+
+
+def _committed_steps(directory: str) -> List[int]:
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        if name.startswith("step_") and os.path.exists(
+            os.path.join(directory, name, _COMMIT)
+        ):
+            out.append(int(name[len("step_"):]))
+    return out
+
+
+def latest_checkpoint(directory: str) -> Optional[int]:
+    steps = _committed_steps(directory)
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(
+    directory: str,
+    target: PyTree,
+    step: Optional[int] = None,
+    shardings: Optional[PyTree] = None,
+) -> Tuple[PyTree, int]:
+    """Restore into the structure (and shardings) of ``target``.
+
+    ``target`` supplies the treedef; leaf values are replaced. If
+    ``shardings`` is given (or target leaves are jax.Arrays with shardings),
+    restored arrays are placed with those shardings — including when the
+    saving topology differed (global arrays are reassembled from every
+    process's shard file first, which must all be visible on shared storage).
+    """
+    if step is None:
+        step = latest_checkpoint(directory)
+        if step is None:
+            raise FileNotFoundError(f"no committed checkpoint in {directory}")
+    ckpt_dir = os.path.join(directory, f"step_{step:08d}")
+    with open(os.path.join(ckpt_dir, _MANIFEST)) as fh:
+        manifest = json.load(fh)
+
+    # Merge every process's shard listing; data is keyed per-process so
+    # identical keys from different processes cannot collide.
+    shard_entries: Dict[str, List[Tuple[int, Dict]]] = {}
+    shard_files: Dict[int, Any] = {}
+    for mpath in sorted(glob.glob(os.path.join(ckpt_dir, "manifest_p*.json"))):
+        with open(mpath) as fh:
+            pm = json.load(fh)
+        p = int(pm["process"])
+        for name, entries in pm["leaves"].items():
+            shard_entries.setdefault(name, []).extend(
+                (p, e) for e in entries
+            )
+    expected = manifest.get("processes", len(shard_files) or 1)
+    found = len(glob.glob(os.path.join(ckpt_dir, "manifest_p*.json")))
+    if found < expected:
+        raise FileNotFoundError(
+            f"checkpoint has {found}/{expected} process manifests — "
+            f"incomplete copy on this filesystem?"
+        )
+
+    def _load(p: int) -> Any:
+        if p not in shard_files:
+            path = os.path.join(ckpt_dir, f"shards_p{p}.npz")
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"missing shard file {path} — incomplete checkpoint copy?"
+                )
+            shard_files[p] = np.load(path)
+        return shard_files[p]
+
+    def assemble(name: str, entry) -> Optional[np.ndarray]:
+        if entry["kind"] == "none":
+            return None
+        shape = tuple(entry["shape"])
+        entries = shard_entries.get(name, [])
+        if not entries:
+            raise KeyError(f"no shard data recorded for leaf {name!r}")
+        # Fast path: one full-coverage shard.
+        if len(entries) == 1:
+            p, e = entries[0]
+            data = _load(p)[e["key"]]
+            if data.shape == shape:
+                return data
+        out = np.zeros(shape, dtype=entry["dtype"])
+        covered = np.zeros(shape[0] if shape else 1, dtype=bool) \
+            if shape else None
+        for p, e in entries:
+            data = _load(p)[e["key"]]
+            idx = tuple(slice(a, b) for a, b in e["index"])
+            out[idx] = data
+            if covered is not None and idx:
+                covered[idx[0]] = True
+        if covered is not None and not covered.all():
+            raise ValueError(
+                f"leaf {name!r}: shards cover only "
+                f"{int(covered.sum())}/{len(covered)} rows — corrupt or "
+                f"incomplete checkpoint"
+            )
+        return out
+
+    flat_target, treedef = flatten_with_names(target)
+    flat_shardings = None
+    if shardings is not None:
+        flat_sh, _ = flatten_with_names(shardings)
+        flat_shardings = dict(flat_sh)
+
+    leaves = []
+    for name, old_leaf in flat_target:
+        entry = manifest["leaves"].get(name)
+        if entry is None:
+            raise KeyError(f"checkpoint missing leaf {name!r}")
+        value = assemble(name, entry)
+        if value is None:
+            leaves.append(None)
+            continue
+        sharding = None
+        if flat_shardings is not None:
+            sharding = flat_shardings.get(name)
+        elif isinstance(old_leaf, jax.Array) and hasattr(old_leaf, "sharding"):
+            sharding = old_leaf.sharding
+        if sharding is not None:
+            value = jax.make_array_from_callback(
+                value.shape, sharding, lambda idx, v=value: v[idx]
+            )
+        leaves.append(value)
+    for f in shard_files.values():
+        f.close()
+    return jax.tree_util.tree_unflatten(treedef, leaves), step
+
+
+# -- manager ----------------------------------------------------------------
+
+
+class CheckpointManager:
+    """Policy wrapper: save-every-N, keep-K, async, auto-resume."""
+
+    def __init__(self, directory: str, every_steps: int = 0, keep: int = 3,
+                 async_write: bool = True):
+        self.directory = directory
+        self.every_steps = every_steps
+        self.keep = keep
+        self.async_write = async_write
+        self._threads: List[threading.Thread] = []
+
+    def should_save(self, step: int) -> bool:
+        return self.every_steps > 0 and step % self.every_steps == 0
+
+    def save(self, step: int, state: PyTree, force: bool = False):
+        if not (force or self.should_save(step)):
+            return
+        self.wait()  # one in-flight async save at a time
+        save_checkpoint(self.directory, step, state, keep=self.keep,
+                        async_write=self.async_write,
+                        _thread_holder=self._threads)
+
+    def restore_or_none(self, target: PyTree, shardings=None):
+        step = latest_checkpoint(self.directory)
+        if step is None:
+            return None, None
+        return restore_checkpoint(self.directory, target, step, shardings)
+
+    def wait(self):
+        for t in self._threads:
+            t.join()
+        self._threads.clear()

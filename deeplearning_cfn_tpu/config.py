@@ -1,0 +1,249 @@
+"""Unified two-tier config system.
+
+The reference has two config tiers (SURVEY.md §6 "Config / flag system"): the
+CloudFormation template *Parameters* (cluster shape: instance type, worker
+count, key name) and per-training-script argparse flags (``--network``,
+``--kv-store``, ``--batch-size``). This module unifies both tiers as nested
+dataclasses: :class:`StackConfig` is the cluster tier, the rest are the
+training tier, and :class:`ExperimentConfig` is the root. Named presets (one
+per BASELINE.json config) live in :mod:`deeplearning_cfn_tpu.presets`; CLI
+dotted-key overrides (``train.base_lr=0.2``) replace per-script flags.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Logical device-mesh shape. Product of axis sizes must equal (or divide
+    evenly into) the device count; ``data = -1`` means "all remaining devices".
+
+    Axes:
+      data     — batch-dim sharding (the reference's only strategy: Horovod
+                 DP-allreduce / KVStore dist_sync both map here).
+      model    — tensor-parallel axis; reserved so pjit specs extend later.
+      spatial  — image H/W sharding for Mask R-CNN's "data+spatial shard".
+    """
+
+    data: int = -1
+    model: int = 1
+    spatial: int = 1
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {"data": self.data, "model": self.model, "spatial": self.spatial}
+
+
+@dataclasses.dataclass
+class OptimizerConfig:
+    name: str = "sgd"  # sgd | momentum | adamw | lars | lamb | adafactor
+    momentum: float = 0.9
+    nesterov: bool = False
+    weight_decay: float = 0.0
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    # LARS/LAMB trust-region knobs (ResNet-50 large-batch recipe).
+    trust_coefficient: float = 0.001
+    grad_clip_norm: float = 0.0  # 0 = off
+
+
+@dataclasses.dataclass
+class ScheduleConfig:
+    """LR schedule; base_lr is scaled linearly with global batch when
+    ``scale_with_batch`` (the Horovod linear-scaling rule the reference's
+    ResNet script used)."""
+
+    name: str = "cosine"  # constant | cosine | step | rsqrt
+    base_lr: float = 0.1
+    warmup_steps: int = 0
+    warmup_epochs: float = 0.0
+    scale_with_batch: bool = False
+    reference_batch: int = 256
+    step_boundaries: Tuple[float, ...] = ()  # fractions of total steps
+    step_factors: Tuple[float, ...] = ()
+    end_lr_factor: float = 0.0
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    global_batch: int = 128
+    eval_batch: int = 0  # 0 = same as global_batch
+    epochs: float = 10.0
+    steps: int = 0  # if >0, overrides epochs
+    eval_every_steps: int = 0  # 0 = per-epoch
+    log_every_steps: int = 50
+    seed: int = 0
+    dtype: str = "bfloat16"  # compute dtype; params stay f32
+    remat: bool = False  # jax.checkpoint the model apply
+    label_smoothing: float = 0.0
+    ema_decay: float = 0.0  # 0 = off
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    name: str = "resnet20"
+    num_classes: int = 10
+    # Free-form per-model kwargs (depth, hidden size, heads, ...).
+    kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class DataConfig:
+    name: str = "cifar10"
+    data_dir: str = ""  # empty → synthetic data (no-network environments)
+    synthetic: bool = False  # force synthetic even if data_dir exists
+    image_size: int = 32
+    seq_len: int = 128  # text workloads
+    vocab_size: int = 30522
+    num_train_examples: int = 0  # 0 = dataset default
+    num_eval_examples: int = 0
+    shuffle_buffer: int = 50_000
+    prefetch: int = 2
+    num_workers: int = 4  # native loader threads
+    use_native_loader: bool = True  # C++ dataio if built, else Python
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    directory: str = ""  # empty → <workdir>/ckpt
+    every_steps: int = 0  # 0 = per-epoch
+    keep: int = 3
+    async_write: bool = True
+    resume: bool = True  # auto-resume from latest on startup
+
+
+@dataclasses.dataclass
+class StackConfig:
+    """Cluster tier — the CFN template Parameters, TPU-shaped.
+
+    Reference parameters (instance type, worker count, key name, SSH CIDR,
+    EFS id) map to: accelerator type + topology (the slice IS the cluster),
+    zone/project (the account context), and no SSH/EFS knobs at all — slice
+    hosts rendezvous through the TPU runtime and share storage via GCS.
+    """
+
+    name: str = "dlcfn"
+    accelerator: str = "tpu"  # tpu | cpu (cpu = local simulation)
+    slice_type: str = "v5p-8"  # e.g. v5p-8 ... v5p-256
+    zone: str = "us-east5-a"
+    project: str = ""
+    runtime_version: str = "tpu-ubuntu2204-base"
+    preemptible: bool = False
+    provisioner: str = "auto"  # auto | gcp | dryrun
+    state_dir: str = ""  # empty → ~/.dlcfn_tpu/stacks
+    create_timeout_s: int = 1800  # WaitCondition-timeout equivalent
+
+
+@dataclasses.dataclass
+class ExperimentConfig:
+    preset: str = ""
+    workdir: str = "/tmp/dlcfn_tpu"
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    schedule: ScheduleConfig = dataclasses.field(default_factory=ScheduleConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+    stack: StackConfig = dataclasses.field(default_factory=StackConfig)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent, default=str)
+
+
+# ---------------------------------------------------------------------------
+# Dotted-key overrides (replaces the reference scripts' argparse flags).
+# ---------------------------------------------------------------------------
+
+_TRUE = {"1", "true", "yes", "on"}
+_FALSE = {"0", "false", "no", "off"}
+
+
+def _coerce(value: str, typ: Any) -> Any:
+    """Coerce a CLI string to the dataclass field's annotated type."""
+    origin = getattr(typ, "__origin__", None)
+    if typ is bool:
+        low = value.lower()
+        if low in _TRUE:
+            return True
+        if low in _FALSE:
+            return False
+        raise ValueError(f"cannot parse {value!r} as bool")
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    if typ is str:
+        return value
+    if origin in (tuple, list):
+        if not value:
+            return origin()
+        items = [v.strip() for v in value.split(",")]
+        args = getattr(typ, "__args__", (str,))
+        elem = args[0] if args else str
+        return origin(_coerce(v, elem) for v in items)
+    if origin is dict or typ in (dict, Dict[str, Any]):
+        return json.loads(value)
+    # Optional[...] / Union fallthrough: try each member type.
+    args = getattr(typ, "__args__", ())
+    for member in args:
+        if member is type(None):
+            continue
+        try:
+            return _coerce(value, member)
+        except (TypeError, ValueError):
+            continue
+    raise TypeError(f"unsupported override type {typ!r}")
+
+
+def _resolve_type(annotation: Any) -> Any:
+    if isinstance(annotation, str):
+        # from __future__ import annotations stores strings; eval in module ns.
+        return eval(annotation, globals())  # noqa: S307 - our own annotations
+    return annotation
+
+
+def apply_overrides(cfg: ExperimentConfig, overrides: List[str]) -> ExperimentConfig:
+    """Apply ``a.b.c=value`` strings in place; returns cfg for chaining.
+
+    Unknown keys raise, with the valid keys in the message — the equivalent
+    of argparse's unknown-flag error in the reference scripts.
+    """
+    for item in overrides:
+        if "=" not in item:
+            raise ValueError(f"override {item!r} is not of the form key=value")
+        dotted, _, raw = item.partition("=")
+        parts = dotted.strip().split(".")
+        obj: Any = cfg
+        for part in parts[:-1]:
+            if not dataclasses.is_dataclass(obj) or part not in {
+                f.name for f in dataclasses.fields(obj)
+            }:
+                raise KeyError(f"unknown config section {part!r} in {dotted!r}")
+            obj = getattr(obj, part)
+        leaf = parts[-1]
+        if dataclasses.is_dataclass(obj):
+            fields = {f.name: f for f in dataclasses.fields(obj)}
+            if leaf not in fields:
+                raise KeyError(
+                    f"unknown config key {dotted!r}; valid keys in this section: "
+                    f"{sorted(fields)}"
+                )
+            typ = _resolve_type(fields[leaf].type)
+            setattr(obj, leaf, _coerce(raw, typ))
+        elif isinstance(obj, dict):
+            # model.kwargs.depth=20 style: store as JSON-ish scalar.
+            try:
+                obj[leaf] = json.loads(raw)
+            except json.JSONDecodeError:
+                obj[leaf] = raw
+        else:
+            raise KeyError(f"cannot set {dotted!r} on {type(obj).__name__}")
+    return cfg

@@ -1,0 +1,13 @@
+"""Input pipelines.
+
+The reference fed GPUs from EFS-mounted datasets via each framework's loader
+(MXNet ImageRecordIter, TF tf.data, TensorPack dataflow — SURVEY.md §3.1).
+The rebuild's contract: a pipeline yields per-process numpy batches
+``{"image"/..., "label"/...}`` of the *local* batch size; the Trainer stitches
+them into globally-sharded arrays. In no-network environments every dataset
+has a deterministic synthetic fallback so all five configs smoke-test
+anywhere; real data paths read standard binary formats via the native C++
+loader (:mod:`deeplearning_cfn_tpu.data.native`) when built.
+"""
+
+from .pipeline import build_pipeline, DataPipeline  # noqa: F401

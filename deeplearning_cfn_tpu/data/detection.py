@@ -1,0 +1,5 @@
+"""Placeholder — detection source lands with the Mask R-CNN milestone."""
+
+
+def build_detection_source(cfg, train):
+    raise NotImplementedError

@@ -1,0 +1,5 @@
+"""Placeholder — real ImageNet file loader lands with Phase 3."""
+
+
+def load_imagenet_source(cfg, train):
+    raise NotImplementedError("real ImageNet loading lands with Phase 3; use synthetic")

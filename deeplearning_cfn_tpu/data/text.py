@@ -1,0 +1,5 @@
+"""Placeholder — text sources land with the BERT/NMT milestones."""
+
+
+def build_text_source(cfg, train):
+    raise NotImplementedError

@@ -1,0 +1,1 @@
+from .jsonl import MetricsWriter, read_metrics  # noqa: F401

@@ -1,0 +1,63 @@
+"""Structured JSONL metrics (SURVEY.md §6 "Metrics / logging").
+
+The reference's observability was stdout prints + CloudWatch agent; the
+rebuild logs one JSON object per event from process 0 (step, loss,
+examples/sec/device — the north-star metric is computed here), flushed line
+by line so the launcher and the bench harness can tail it live.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, IO, List, Optional
+
+import jax
+
+
+class MetricsWriter:
+    """Append-only JSONL writer; no-op on non-zero processes by default so
+    multi-host runs produce one metrics stream (the reference's "rank 0
+    prints" convention)."""
+
+    def __init__(self, path: Optional[str], also_stdout: bool = True,
+                 all_processes: bool = False):
+        self.enabled = all_processes or jax.process_index() == 0
+        self.also_stdout = also_stdout
+        self._fh: Optional[IO[str]] = None
+        if self.enabled and path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._fh = open(path, "a", buffering=1)
+
+    def write(self, record: Dict[str, Any]) -> None:
+        if not self.enabled:
+            return
+        record = {"ts": time.time(), **record}
+        line = json.dumps(record, default=float)
+        if self._fh is not None:
+            self._fh.write(line + "\n")
+        if self.also_stdout:
+            print(line, file=sys.stdout, flush=True)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_metrics(path: str) -> List[Dict[str, Any]]:
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -1,0 +1,35 @@
+"""Model zoo — the five BASELINE.json workloads, rebuilt as Flax modules.
+
+Registry maps ModelConfig.name → constructor. Each model module documents the
+reference workload it replaces (SURVEY.md §3.1) and its TPU-first design
+choices (bfloat16 compute, static shapes, MXU-friendly dims).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+_REGISTRY: Dict[str, Callable[..., Any]] = {}
+
+
+def register_model(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def build_model(name: str, num_classes: int, dtype, **kwargs):
+    # Import model modules lazily so `import deeplearning_cfn_tpu` stays cheap.
+    from . import resnet, bert, transformer_nmt, maskrcnn  # noqa: F401
+
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; available: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](num_classes=num_classes, dtype=dtype, **kwargs)
+
+
+def list_models():
+    from . import resnet, bert, transformer_nmt, maskrcnn  # noqa: F401
+
+    return sorted(_REGISTRY)

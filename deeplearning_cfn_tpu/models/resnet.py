@@ -1,0 +1,160 @@
+"""ResNets: ResNet-20 (CIFAR-10) and ResNet-50 (ImageNet).
+
+Replaces the reference's two image-classification workloads (SURVEY.md §3.1):
+the MXNet ``train_cifar10.py --network resnet`` example (ResNet-20, the
+CPU-runnable smoke config) and the TF+Horovod ImageNet ResNet-50.
+
+TPU-first choices:
+- bfloat16 activations/conv compute, float32 params and BatchNorm statistics
+  (the standard TPU mixed-precision recipe); the MXU natively consumes bf16.
+- BatchNorm runs inside the single jit-compiled global program, so its batch
+  mean/var are computed over the *global* (mesh-sharded) batch by
+  compiler-inserted ICI collectives — the pjit equivalent of sync-BN, for free.
+- Static shapes throughout; no Python control flow in the forward pass.
+- Channel counts are multiples of 8/128 where the architecture allows, so XLA
+  tiles cleanly onto the 128×128 MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from . import register_model
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    """3x3 + 3x3 residual block (ResNet-18/20/34 style)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 → 3x3 → 1x1 bottleneck (ResNet-50/101/152)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # Zero-init the last BN scale so each block starts as identity — the
+        # large-batch trick the Horovod/LARS recipes rely on.
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+    cifar_stem: bool = False  # 3x3/s1 stem, no maxpool (CIFAR variants)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME",
+            kernel_init=nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+        )
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32,
+        )
+        act = nn.relu
+
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = conv(self.num_filters, (3, 3), name="conv_init")(x)
+            x = norm(name="norm_init")(x)
+            x = act(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+            x = norm(name="norm_init")(x)
+            x = act(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.num_filters * 2**i,
+                    conv=conv, norm=norm, act=act, strides=strides,
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     kernel_init=nn.initializers.zeros_init(), name="head")(x)
+        return x.astype(jnp.float32)
+
+
+@register_model("resnet20")
+def resnet20(num_classes: int = 10, dtype=jnp.float32, **kw):
+    # 3 stages × 3 BasicBlocks, 16/32/64 filters — He et al.'s CIFAR ResNet-20,
+    # matching the MXNet example's `--network resnet --num-layers 20`.
+    return ResNet(stage_sizes=[3, 3, 3], block_cls=BasicBlock,
+                  num_classes=num_classes, num_filters=16, dtype=dtype,
+                  cifar_stem=True, **kw)
+
+
+@register_model("resnet32")
+def resnet32(num_classes: int = 10, dtype=jnp.float32, **kw):
+    return ResNet(stage_sizes=[5, 5, 5], block_cls=BasicBlock,
+                  num_classes=num_classes, num_filters=16, dtype=dtype,
+                  cifar_stem=True, **kw)
+
+
+@register_model("resnet18")
+def resnet18(num_classes: int = 1000, dtype=jnp.bfloat16, **kw):
+    return ResNet(stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock,
+                  num_classes=num_classes, dtype=dtype, **kw)
+
+
+@register_model("resnet50")
+def resnet50(num_classes: int = 1000, dtype=jnp.bfloat16, **kw):
+    return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock,
+                  num_classes=num_classes, dtype=dtype, **kw)
+
+
+@register_model("resnet101")
+def resnet101(num_classes: int = 1000, dtype=jnp.bfloat16, **kw):
+    return ResNet(stage_sizes=[3, 4, 23, 3], block_cls=BottleneckBlock,
+                  num_classes=num_classes, dtype=dtype, **kw)

@@ -1,0 +1,18 @@
+"""Parallelism layer: device mesh construction + sharding specs.
+
+This is the TPU-native replacement for the reference's entire L3 stack
+(SURVEY.md §2 L3): Horovod's C++ core + NCCL + EFA on the allreduce path, and
+MXNet ps-lite KVStore on the parameter-server path. Here there is no comm
+library to configure — collectives are XLA-scheduled over ICI inside the
+compiled step; this package's job is mesh/topology math and sharding-spec
+construction.
+"""
+
+from .mesh import MeshSpec, build_mesh, local_batch_size  # noqa: F401
+from .sharding import (  # noqa: F401
+    batch_sharding,
+    named_sharding,
+    replicated,
+    shard_params,
+    param_sharding_tree,
+)

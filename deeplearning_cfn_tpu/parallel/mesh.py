@@ -1,0 +1,141 @@
+"""Device-mesh construction and topology math.
+
+Replaces the reference's cluster-shape plumbing: where the CFN template's
+Parameters (worker count × GPUs/worker) plus the generated hostfile defined the
+communicator world for Horovod/MPI and KVStore (SURVEY.md §4.1), here the
+world is a :class:`jax.sharding.Mesh` over the slice's chips, and "topology"
+is which logical axis (data/model/spatial) maps onto which physical ICI axes.
+XLA then schedules collectives over ICI along those axes — the hostfile, the
+SSH mesh, and the NCCL ring all collapse into this one object.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from ..config import MeshConfig
+
+# Axis order matters: 'data' outermost so per-host batches stay contiguous
+# (each host feeds only its local shard of the batch), 'model' innermost so
+# tensor-parallel collectives ride the shortest ICI hops.
+AXIS_ORDER: Tuple[str, ...] = ("data", "spatial", "model")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Resolved logical mesh shape (all axes concrete, product == #devices)."""
+
+    data: int
+    model: int = 1
+    spatial: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.model * self.spatial
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {"data": self.data, "spatial": self.spatial, "model": self.model}
+
+    @classmethod
+    def resolve(cls, cfg: MeshConfig, num_devices: int) -> "MeshSpec":
+        """Resolve ``data = -1`` ("all remaining devices") against a device
+        count and validate divisibility — the topology math the reference did
+        by hand via ``$DEEPLEARNING_WORKERS_COUNT × GPUs``."""
+        model = cfg.model
+        spatial = cfg.spatial
+        if model < 1 or spatial < 1:
+            raise ValueError(f"model/spatial axes must be >=1, got {cfg}")
+        fixed = model * spatial
+        if num_devices % fixed != 0:
+            raise ValueError(
+                f"model*spatial={fixed} does not divide device count {num_devices}"
+            )
+        data = cfg.data
+        if data == -1:
+            data = num_devices // fixed
+        if data * fixed != num_devices:
+            raise ValueError(
+                f"mesh {data}x{spatial}x{model} != {num_devices} devices; "
+                f"set data=-1 to auto-size"
+            )
+        return cls(data=data, model=model, spatial=spatial)
+
+
+def build_mesh(
+    cfg: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the global :class:`Mesh` for this process.
+
+    Uses ``mesh_utils.create_device_mesh`` so the logical axes map onto
+    physically-contiguous ICI neighborhoods (nearest-neighbor torus links),
+    keeping allreduce on ICI instead of hopping DCN.
+    """
+    cfg = cfg or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    spec = MeshSpec.resolve(cfg, len(devices))
+    shape = tuple(spec.axis_sizes()[a] for a in AXIS_ORDER)
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except (ValueError, AssertionError, NotImplementedError):
+        # Fallback for host-simulated CPU meshes and odd device counts.
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+    """Per-process batch size: the global batch divided across the processes
+    that feed the 'data' axis. Each host feeds only its addressable shard —
+    the TPU equivalent of Horovod's per-rank batch."""
+    n_proc = jax.process_count()
+    if global_batch % n_proc != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by process count {n_proc}"
+        )
+    if global_batch % mesh.shape["data"] != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by data-axis size "
+            f"{mesh.shape['data']}"
+        )
+    return global_batch // n_proc
+
+
+def validate_batch(global_batch: int, mesh: Mesh) -> None:
+    if global_batch % mesh.shape["data"] != 0:
+        raise ValueError(
+            f"global batch {global_batch} must be divisible by the data axis "
+            f"({mesh.shape['data']})"
+        )
+
+
+def describe(mesh: Mesh) -> str:
+    """Human-readable topology line for logs — the rebuild's replacement for
+    the reference printing the hostfile + `$DEEPLEARNING_WORKERS_COUNT`."""
+    axes = ", ".join(f"{a}={s}" for a, s in mesh.shape.items())
+    return (
+        f"mesh[{axes}] over {mesh.devices.size} devices "
+        f"({jax.process_count()} processes, "
+        f"{len([d for d in mesh.devices.flat if d.process_index == jax.process_index()])} "
+        f"local)"
+    )
+
+
+def slice_chip_count(slice_type: str) -> int:
+    """Chips in a TPU slice type string like 'v5p-8' (the number suffix is
+    the chip count for v5p/v4 naming)."""
+    try:
+        return int(slice_type.rsplit("-", 1)[1])
+    except (IndexError, ValueError) as e:
+        raise ValueError(f"cannot parse slice type {slice_type!r}") from e
+
+
+def hosts_for_slice(slice_type: str, chips_per_host: int = 4) -> int:
+    chips = slice_chip_count(slice_type)
+    return max(1, math.ceil(chips / chips_per_host))

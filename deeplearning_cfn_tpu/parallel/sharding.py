@@ -1,0 +1,92 @@
+"""Sharding-spec construction: how tensors lay out over the mesh.
+
+In the reference, data-parallel layout was implicit in process structure (one
+process per GPU, each with a full replica; Horovod allreduced grads, KVStore
+push/pulled them — SURVEY.md §4.2/4.3). Here layout is explicit and the
+compiler inserts the collectives: batch tensors are sharded over the 'data'
+axis, params replicated (or sharded over 'model' by rule), and the gradient
+psum over ICI appears automatically because the loss is a mean over a sharded
+batch dim inside one jit-compiled program.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PyTree = Any
+
+# Rules mapping flattened param-path regexes → PartitionSpec, applied first
+# match wins. Default (no match) is fully replicated — correct for pure DP,
+# which is the reference's only strategy. Tensor-parallel rules are added by
+# models that opt into the 'model' axis.
+Rule = Tuple[str, P]
+
+
+def named_sharding(mesh: Mesh, *spec: Optional[str]) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int, spatial_dim: Optional[int] = None) -> NamedSharding:
+    """Batch tensors: dim 0 over 'data'; optionally one spatial dim over
+    'spatial' (Mask R-CNN's data+spatial shard)."""
+    spec: list = [None] * ndim
+    spec[0] = "data"
+    if spatial_dim is not None and mesh.shape.get("spatial", 1) > 1:
+        spec[spatial_dim] = "spatial"
+    return NamedSharding(mesh, P(*spec))
+
+
+from ..utils.trees import path_str as _path_str  # shared with ckpt manifests
+
+
+def param_sharding_tree(
+    params: PyTree, mesh: Mesh, rules: Sequence[Rule] = ()
+) -> PyTree:
+    """Build a NamedSharding tree for a param tree from path-regex rules.
+
+    With no rules everything is replicated — pjit-DP, matching the reference's
+    replica-per-GPU layout without the N copies of optimizer traffic.
+    """
+
+    def assign(path, leaf):
+        name = _path_str(path)
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                # Drop axes the leaf can't carry (e.g. bias with a 2-dim rule).
+                if len([s for s in spec if s is not None]) > leaf.ndim:
+                    continue
+                if len(spec) > leaf.ndim:
+                    spec = P(*spec[: leaf.ndim])
+                return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(assign, params)
+
+
+def shard_params(params: PyTree, mesh: Mesh, rules: Sequence[Rule] = ()) -> PyTree:
+    """Place a param tree onto the mesh per the rules (device_put each leaf)."""
+    shardings = param_sharding_tree(params, mesh, rules)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), params, shardings
+    )
+
+
+def local_shard(array, mesh: Mesh, global_batch: int):
+    """Assemble a globally-sharded batch array from this process's local data.
+
+    Multi-host: each process holds only its slice of the batch;
+    ``jax.make_array_from_process_local_data`` stitches the global logical
+    array. This is the feed-side half of the reference's "each rank reads its
+    own shard of the dataset" contract.
+    """
+    sharding = batch_sharding(mesh, array.ndim)
+    global_shape = (global_batch,) + tuple(array.shape[1:])
+    return jax.make_array_from_process_local_data(sharding, array, global_shape)

@@ -1,0 +1,159 @@
+"""Named experiment presets — the five BASELINE.json acceptance configs.
+
+These are the rebuild's equivalent of the reference's bundled example scripts
+(SURVEY.md §3.1): each preset pins the model/data/optimizer/schedule recipe the
+corresponding reference workload used, re-expressed for the pjit-DP trainer.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Dict, List
+
+from .config import (
+    CheckpointConfig,
+    DataConfig,
+    ExperimentConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimizerConfig,
+    ScheduleConfig,
+    StackConfig,
+    TrainConfig,
+)
+
+_REGISTRY: Dict[str, Callable[[], ExperimentConfig]] = {}
+
+
+def register_preset(name: str):
+    def deco(fn: Callable[[], ExperimentConfig]):
+        if name in _REGISTRY:
+            raise ValueError(f"duplicate preset {name!r}")
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def list_presets() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def get_preset(name: str) -> ExperimentConfig:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown preset {name!r}; available: {list_presets()}")
+    cfg = _REGISTRY[name]()
+    cfg.preset = name
+    return copy.deepcopy(cfg)
+
+
+@register_preset("cifar10_resnet20")
+def _cifar10_resnet20() -> ExperimentConfig:
+    """CIFAR-10 ResNet-20 — the reference's CPU-runnable smoke workload
+    (MXNet ``train_cifar10.py --network resnet --kv-store dist_sync``)."""
+    return ExperimentConfig(
+        model=ModelConfig(name="resnet20", num_classes=10),
+        data=DataConfig(name="cifar10", image_size=32),
+        train=TrainConfig(global_batch=128, epochs=60.0, dtype="float32"),
+        optimizer=OptimizerConfig(name="momentum", momentum=0.9, weight_decay=1e-4),
+        schedule=ScheduleConfig(
+            name="step",
+            base_lr=0.1,
+            warmup_epochs=1.0,
+            step_boundaries=(0.5, 0.75),
+            step_factors=(0.1, 0.01),
+        ),
+        mesh=MeshConfig(data=-1),
+        stack=StackConfig(slice_type="v5p-8"),
+    )
+
+
+@register_preset("imagenet_resnet50")
+def _imagenet_resnet50() -> ExperimentConfig:
+    """ImageNet ResNet-50 DP — the north-star config (reference: TF+Horovod
+    ResNet-50, NCCL allreduce over EFA). Large-batch LARS recipe to 75.9%."""
+    return ExperimentConfig(
+        model=ModelConfig(name="resnet50", num_classes=1000),
+        data=DataConfig(name="imagenet", image_size=224),
+        train=TrainConfig(global_batch=8192, epochs=90.0, dtype="bfloat16",
+                          label_smoothing=0.1),
+        optimizer=OptimizerConfig(
+            name="lars", momentum=0.9, weight_decay=1e-4, trust_coefficient=0.001
+        ),
+        schedule=ScheduleConfig(
+            name="cosine",
+            base_lr=2.0,  # LARS base for batch 8192 ("
+            warmup_epochs=5.0,
+            scale_with_batch=True,
+            reference_batch=8192,
+        ),
+        mesh=MeshConfig(data=-1),
+        stack=StackConfig(slice_type="v5p-256"),
+    )
+
+
+@register_preset("bert_base_wikipedia")
+def _bert_base() -> ExperimentConfig:
+    """BERT-base MLM+NSP pretraining (reference: TF+Horovod BERT scripts)."""
+    return ExperimentConfig(
+        model=ModelConfig(
+            name="bert_base",
+            num_classes=2,  # NSP head
+            kwargs=dict(
+                hidden_size=768, num_layers=12, num_heads=12, mlp_dim=3072,
+                max_len=512,
+            ),
+        ),
+        data=DataConfig(name="wikipedia_mlm", seq_len=128, vocab_size=30522),
+        train=TrainConfig(global_batch=1024, steps=100_000, dtype="bfloat16"),
+        optimizer=OptimizerConfig(name="lamb", weight_decay=0.01,
+                                  grad_clip_norm=1.0),
+        schedule=ScheduleConfig(name="cosine", base_lr=1e-3, warmup_steps=3000),
+        mesh=MeshConfig(data=-1),
+        stack=StackConfig(slice_type="v5p-64"),
+    )
+
+
+@register_preset("maskrcnn_coco")
+def _maskrcnn() -> ExperimentConfig:
+    """Mask R-CNN COCO — the one beyond-DP config: pjit data+spatial shard
+    (reference: TensorPack HorovodTrainer multi-node)."""
+    return ExperimentConfig(
+        model=ModelConfig(
+            name="maskrcnn_resnet50",
+            num_classes=91,
+            kwargs=dict(image_size=1024, max_boxes=100),
+        ),
+        data=DataConfig(name="coco", image_size=1024),
+        train=TrainConfig(global_batch=64, epochs=24.0, dtype="bfloat16"),
+        optimizer=OptimizerConfig(name="momentum", momentum=0.9,
+                                  weight_decay=1e-4, grad_clip_norm=10.0),
+        schedule=ScheduleConfig(
+            name="step", base_lr=0.08, warmup_steps=500,
+            step_boundaries=(0.66, 0.88), step_factors=(0.1, 0.01),
+        ),
+        mesh=MeshConfig(data=-1, spatial=2),
+        stack=StackConfig(slice_type="v5p-64"),
+    )
+
+
+@register_preset("transformer_nmt_wmt")
+def _nmt() -> ExperimentConfig:
+    """Transformer NMT WMT En-De (reference: Sockeye + MXNet
+    ``--kvstore dist_device_sync``)."""
+    return ExperimentConfig(
+        model=ModelConfig(
+            name="transformer_nmt",
+            kwargs=dict(
+                hidden_size=512, num_layers=6, num_heads=8, mlp_dim=2048,
+            ),
+        ),
+        data=DataConfig(name="wmt_en_de", seq_len=128, vocab_size=32000),
+        train=TrainConfig(global_batch=2048, steps=100_000, dtype="bfloat16",
+                          label_smoothing=0.1),
+        optimizer=OptimizerConfig(name="adamw", b1=0.9, b2=0.98,
+                                  weight_decay=0.0, grad_clip_norm=0.0),
+        schedule=ScheduleConfig(name="rsqrt", base_lr=1.0, warmup_steps=4000),
+        mesh=MeshConfig(data=-1),
+        stack=StackConfig(slice_type="v5p-32"),
+    )

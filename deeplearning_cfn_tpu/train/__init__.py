@@ -1,0 +1,11 @@
+"""Training layer: optimizer/schedule factories, train state, sharded trainer.
+
+Replaces the reference's L4 training loops (SURVEY.md §4.2/§4.3): the Horovod
+``DistributedOptimizer`` + broadcast hook pattern and the MXNet KVStore
+``module.fit`` loop both become one jit-compiled step function whose gradient
+allreduce is a compiler-inserted psum over ICI.
+"""
+
+from .optim import build_optimizer, build_schedule  # noqa: F401
+from .state import TrainState, create_train_state  # noqa: F401
+from .trainer import Trainer  # noqa: F401

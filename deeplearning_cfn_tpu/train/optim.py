@@ -1,0 +1,133 @@
+"""Optimizer + LR-schedule factories.
+
+Covers every recipe the reference workloads used (SURVEY.md §3.1): momentum
+SGD (MXNet image-classification, TensorPack Mask R-CNN), Horovod-style linear
+LR scaling + warmup (TF ResNet-50), LARS for the large-batch ResNet-50 north
+star, LAMB/AdamW (BERT), and the Transformer rsqrt schedule (Sockeye NMT).
+Built on optax; LARS/LAMB are composed from optax primitives so the trust-ratio
+math runs inside the compiled step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import optax
+
+from ..config import OptimizerConfig, ScheduleConfig
+
+
+def build_schedule(
+    cfg: ScheduleConfig, total_steps: int, global_batch: int,
+    steps_per_epoch: Optional[int] = None,
+) -> optax.Schedule:
+    base_lr = cfg.base_lr
+    if cfg.scale_with_batch and cfg.reference_batch > 0:
+        # Horovod linear-scaling rule: lr ∝ global batch.
+        base_lr = cfg.base_lr * global_batch / cfg.reference_batch
+
+    warmup = cfg.warmup_steps
+    if warmup == 0 and cfg.warmup_epochs > 0 and steps_per_epoch:
+        warmup = int(cfg.warmup_epochs * steps_per_epoch)
+    warmup = min(warmup, max(total_steps - 1, 0))
+    decay_steps = max(total_steps - warmup, 1)
+
+    if cfg.name == "constant":
+        main = optax.constant_schedule(base_lr)
+    elif cfg.name == "cosine":
+        main = optax.cosine_decay_schedule(
+            base_lr, decay_steps, alpha=cfg.end_lr_factor
+        )
+    elif cfg.name == "step":
+        boundaries = {
+            int(frac * decay_steps): factor
+            for frac, factor in zip(cfg.step_boundaries, cfg.step_factors)
+        }
+        # optax piecewise_constant_schedule multiplies by the *ratio* at each
+        # boundary; convert absolute factors to ratios.
+        ratios = {}
+        prev = 1.0
+        for step in sorted(boundaries):
+            ratios[step] = boundaries[step] / prev
+            prev = boundaries[step]
+        main = optax.piecewise_constant_schedule(base_lr, ratios)
+    elif cfg.name == "rsqrt":
+        # Transformer (Vaswani) schedule: d^-0.5 folded into base_lr;
+        # lr = base * min(step^-0.5, step * warmup^-1.5). Implemented directly.
+        w = max(warmup, 1)
+
+        def main(step):  # type: ignore[misc]
+            s = step + 1.0
+            return base_lr * (w ** -0.5) * (
+                (s / w) if s < w else (s / w) ** -0.5
+            )
+
+        # rsqrt embeds its own warmup — skip the generic warmup join below.
+        return main
+    else:
+        raise ValueError(f"unknown schedule {cfg.name!r}")
+
+    if warmup > 0:
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, base_lr, warmup), main], [warmup]
+        )
+    return main
+
+
+def build_optimizer(
+    cfg: OptimizerConfig, schedule: optax.Schedule
+) -> optax.GradientTransformation:
+    chain = []
+    if cfg.grad_clip_norm > 0:
+        chain.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+
+    name = cfg.name.lower()
+    # Decoupled weight decay for optimizers that don't fold it in themselves.
+    if cfg.weight_decay > 0 and name in ("sgd", "momentum", "adam",
+                                         "adafactor"):
+        chain.append(optax.add_decayed_weights(cfg.weight_decay,
+                                               mask=_non_bn_mask))
+    if name == "sgd":
+        chain.append(optax.sgd(schedule))
+    elif name == "momentum":
+        chain.append(
+            optax.sgd(schedule, momentum=cfg.momentum, nesterov=cfg.nesterov)
+        )
+    elif name == "adamw":
+        chain.append(
+            optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
+                        weight_decay=cfg.weight_decay)
+        )
+    elif name == "adam":
+        chain.append(optax.adam(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps))
+    elif name == "lars":
+        chain.append(
+            optax.lars(
+                schedule,
+                weight_decay=cfg.weight_decay,
+                trust_coefficient=cfg.trust_coefficient,
+                momentum=cfg.momentum,
+                nesterov=cfg.nesterov,
+                # Standard recipe: no WD / trust-ratio on BN params and biases.
+                weight_decay_mask=_non_bn_mask,
+                trust_ratio_mask=_non_bn_mask,
+            )
+        )
+    elif name == "lamb":
+        chain.append(
+            optax.lamb(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
+                       weight_decay=cfg.weight_decay, mask=_non_bn_mask)
+        )
+    elif name == "adafactor":
+        chain.append(optax.adafactor(schedule))
+    else:
+        raise ValueError(f"unknown optimizer {cfg.name!r}")
+    return optax.chain(*chain)
+
+
+def _non_bn_mask(params):
+    """True for leaves that should get weight decay / trust-ratio scaling:
+    everything except 1-D params (BatchNorm scale/bias, LayerNorm, biases)."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda p: p.ndim > 1, params)

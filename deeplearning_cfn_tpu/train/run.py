@@ -1,0 +1,111 @@
+"""Experiment runner: config in → trained state out.
+
+This is the engine behind the ``train`` CLI verb (SURVEY.md §4.4): it builds
+the mesh, task, data pipeline, optimizer, sharded state, wires metrics +
+checkpointing (with auto-resume), and runs the Trainer loop. The reference
+spread this across per-framework example scripts + launch wrappers; here it is
+one code path for all five workloads.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from ..ckpt import CheckpointManager
+from ..config import ExperimentConfig
+from ..data import build_pipeline
+from ..metrics import MetricsWriter
+from ..parallel.mesh import build_mesh, describe, local_batch_size
+from .optim import build_optimizer, build_schedule
+from .state import create_train_state
+from .task import build_task
+from .trainer import Trainer
+
+
+def run_experiment(
+    cfg: ExperimentConfig,
+    max_steps: Optional[int] = None,
+    mesh=None,
+) -> Dict[str, float]:
+    """Run (or resume) the experiment; returns final eval metrics."""
+    mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
+    task = build_task(cfg)
+
+    local_batch = local_batch_size(cfg.train.global_batch, mesh)
+    train_pipe = build_pipeline(cfg.data, local_batch,
+                                cfg.model.num_classes, seed=cfg.train.seed,
+                                train=True)
+    eval_batch = cfg.train.eval_batch or cfg.train.global_batch
+    eval_pipe = build_pipeline(cfg.data, eval_batch // jax.process_count(),
+                               cfg.model.num_classes, seed=cfg.train.seed,
+                               train=False)
+
+    steps_per_epoch = max(train_pipe.steps_per_epoch, 1)
+    total_steps = (cfg.train.steps if cfg.train.steps > 0
+                   else int(cfg.train.epochs * steps_per_epoch))
+    if max_steps is not None:
+        total_steps = min(total_steps, max_steps)
+
+    schedule = build_schedule(cfg.schedule, total_steps,
+                              cfg.train.global_batch, steps_per_epoch)
+    tx = build_optimizer(cfg.optimizer, schedule)
+
+    rng = jax.random.PRNGKey(cfg.train.seed)
+    init_rng, data_rng, train_rng = jax.random.split(rng, 3)
+    state = create_train_state(
+        init_rng, task.init, tx, mesh,
+        param_rules=getattr(task, "param_rules", ()),
+        ema=cfg.train.ema_decay > 0,
+    )
+
+    workdir = os.path.join(cfg.workdir, cfg.preset or cfg.model.name)
+    ckpt_dir = cfg.checkpoint.directory or os.path.join(workdir, "ckpt")
+    ckpt_every = cfg.checkpoint.every_steps or steps_per_epoch
+    manager = CheckpointManager(ckpt_dir, every_steps=ckpt_every,
+                                keep=cfg.checkpoint.keep,
+                                async_write=cfg.checkpoint.async_write)
+    if cfg.checkpoint.resume:
+        restored, at_step = manager.restore_or_none(state)
+        if restored is not None:
+            state = restored
+            if jax.process_index() == 0:
+                print(f"[dlcfn-tpu] resumed from step {at_step}")
+
+    trainer = Trainer(cfg, task.loss_fn, tx, mesh=mesh,
+                      spatial_dim=getattr(task, "spatial_dim", None))
+    metrics_path = os.path.join(workdir, "metrics.jsonl")
+    writer = MetricsWriter(metrics_path)
+    if jax.process_index() == 0:
+        print(f"[dlcfn-tpu] {describe(mesh)}")
+        print(f"[dlcfn-tpu] total_steps={total_steps} "
+              f"steps_per_epoch={steps_per_epoch} "
+              f"global_batch={cfg.train.global_batch}")
+
+    def ckpt_hook(step, st, _metrics):
+        manager.save(step, st)
+
+    eval_every = cfg.train.eval_every_steps or steps_per_epoch
+    state = trainer.fit(
+        state,
+        train_pipe.epochs(start_epoch=int(state.step) // steps_per_epoch),
+        num_steps=total_steps,
+        rng=train_rng,
+        eval_iter_fn=lambda: eval_pipe.one_epoch(),
+        eval_every=eval_every,
+        hooks=(ckpt_hook,),
+        log_every=cfg.train.log_every_steps,
+        metrics_writer=writer,
+    )
+    manager.save(int(state.step), state, force=True)
+    manager.wait()
+
+    final = trainer.evaluate(state, eval_pipe.one_epoch())
+    writer.write({"step": int(state.step),
+                  **{f"final_eval_{k}": v for k, v in final.items()}})
+    writer.close()
+    del data_rng
+    return final

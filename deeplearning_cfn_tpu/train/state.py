@@ -1,0 +1,130 @@
+"""Sharded train state.
+
+The reference kept replica state per-process (each GPU rank held its own full
+copy; Horovod broadcast from rank 0 at start — SURVEY.md §4.2). Here state is
+one logical pytree with explicit NamedShardings over the mesh; "broadcast from
+rank 0" is replaced by initializing under a sharding constraint so every
+device materializes the same (or its shard of the) state directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from ..parallel.sharding import param_sharding_tree, replicated
+
+PyTree = Any
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: jnp.ndarray
+    params: PyTree
+    batch_stats: PyTree  # BatchNorm running stats ({} for stat-free models)
+    opt_state: PyTree
+    ema_params: Optional[PyTree] = None
+
+    def apply_gradients(self, grads: PyTree, tx: optax.GradientTransformation,
+                        ema_decay: float = 0.0) -> "TrainState":
+        updates, new_opt_state = tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        new_ema = self.ema_params
+        if new_ema is not None and ema_decay > 0:
+            new_ema = jax.tree_util.tree_map(
+                lambda e, p: e * ema_decay + p * (1.0 - ema_decay),
+                new_ema, new_params,
+            )
+        return self.replace(
+            step=self.step + 1, params=new_params, opt_state=new_opt_state,
+            ema_params=new_ema,
+        )
+
+
+def create_train_state(
+    rng: jax.Array,
+    init_fn: Callable[[jax.Array], PyTree],
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    param_rules=(),
+    ema: bool = False,
+) -> TrainState:
+    """Initialize state directly into its sharded layout.
+
+    ``init_fn(rng)`` returns flax variables ({'params': ..., 'batch_stats'?}).
+    Init runs under jit with output shardings derived from the param rules so
+    large models never materialize unsharded on one device — the TPU
+    replacement for "rank 0 inits then broadcasts".
+    """
+    var_shapes = jax.eval_shape(init_fn, rng)
+    params_shape = var_shapes["params"]
+    param_sh = param_sharding_tree(params_shape, mesh, param_rules)
+    stats_shape = var_shapes.get("batch_stats", {})
+    stats_sh = jax.tree_util.tree_map(lambda _: replicated(mesh), stats_shape)
+
+    def make_state(rng):
+        variables = init_fn(rng)
+        params = variables["params"]
+        stats = variables.get("batch_stats", {})
+        opt_state = tx.init(params)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=stats,
+            opt_state=opt_state,
+            ema_params=params if ema else None,
+        )
+
+    state_shapes = jax.eval_shape(make_state, rng)
+
+    # Sharding tree: params + ema follow the rules; opt_state slots that
+    # mirror params inherit their sharding; everything else replicated.
+    out_sh = TrainState(
+        step=replicated(mesh),
+        params=param_sh,
+        batch_stats=stats_sh,
+        opt_state=_opt_state_shardings(state_shapes.opt_state, params_shape,
+                                       param_sh, mesh),
+        ema_params=param_sh if ema else None,
+    )
+    make_sharded = jax.jit(make_state, out_shardings=out_sh)
+    return make_sharded(rng)
+
+
+def _opt_state_shardings(opt_state_shape, params_shape, param_sh, mesh):
+    """Optimizer slots that mirror a param (momentum, mu/nu) inherit its
+    sharding; scalars/counters are replicated. Matched structurally: any
+    subtree of opt_state whose treedef equals the param treedef gets param
+    shardings."""
+    params_def = jax.tree_util.tree_structure(params_shape)
+    param_sh_leaves = jax.tree_util.tree_leaves(param_sh)
+
+    def assign(node):
+        try:
+            node_def = jax.tree_util.tree_structure(node)
+        except Exception:  # pragma: no cover
+            return None
+        if node_def == params_def:
+            return jax.tree_util.tree_unflatten(node_def, param_sh_leaves)
+        return None
+
+    def recurse(node):
+        hit = assign(node)
+        if hit is not None:
+            return hit
+        if isinstance(node, tuple) and type(node) is not tuple:
+            # NamedTuple (optax states): recurse fieldwise, rebuild same type.
+            return type(node)(*(recurse(c) for c in node))
+        if isinstance(node, tuple):
+            return tuple(recurse(c) for c in node)
+        if isinstance(node, list):
+            return [recurse(c) for c in node]
+        if isinstance(node, dict):
+            return {k: recurse(v) for k, v in node.items()}
+        return replicated(mesh)
+
+    return recurse(opt_state_shape)

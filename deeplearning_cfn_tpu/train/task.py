@@ -1,0 +1,96 @@
+"""Task definitions: glue a model into the Trainer's loss_fn contract.
+
+The reference expressed this per-script (each example had its own loss/metric
+code inline — SURVEY.md §3.1); here a Task builds the ``loss_fn(params,
+batch_stats, batch, rng, train)`` closure from a Flax module plus the config,
+so every workload shares one trainer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..config import ExperimentConfig
+from ..models import build_model
+
+PyTree = Any
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
+                  smoothing: float = 0.0) -> jnp.ndarray:
+    num_classes = logits.shape[-1]
+    if smoothing > 0:
+        on = 1.0 - smoothing
+        off = smoothing / (num_classes - 1)
+        targets = jax.nn.one_hot(labels, num_classes) * (on - off) + off
+        return optax.softmax_cross_entropy(logits, targets)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
+class ClassificationTask:
+    """Image classification (CIFAR ResNet-20, ImageNet ResNet-50).
+
+    Batch contract: ``{"image": [B,H,W,C] float32, "label": [B] int32}``.
+    """
+
+    def __init__(self, cfg: ExperimentConfig):
+        self.cfg = cfg
+        dtype = jnp.bfloat16 if cfg.train.dtype == "bfloat16" else jnp.float32
+        self.model = build_model(
+            cfg.model.name, cfg.model.num_classes, dtype, **cfg.model.kwargs
+        )
+        if cfg.train.remat:
+            # Rematerialize the full forward: trade FLOPs for HBM.
+            self.model = jax.checkpoint(self.model)  # pragma: no cover
+
+    def init(self, rng: jax.Array):
+        shape = (1, self.cfg.data.image_size, self.cfg.data.image_size, 3)
+        dummy = jnp.zeros(shape, jnp.float32)
+        return self.model.init(rng, dummy, train=False)
+
+    def loss_fn(self, params: PyTree, batch_stats: PyTree,
+                batch: Dict[str, jnp.ndarray], rng, train: bool
+                ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        variables = {"params": params}
+        has_stats = bool(batch_stats)
+        if has_stats:
+            variables["batch_stats"] = batch_stats
+        if train and has_stats:
+            logits, mutated = self.model.apply(
+                variables, batch["image"], train=True, mutable=["batch_stats"]
+            )
+            new_stats = mutated["batch_stats"]
+        else:
+            logits = self.model.apply(variables, batch["image"], train=False)
+            new_stats = batch_stats
+        # Global-batch mean: with the batch dim sharded over 'data', XLA turns
+        # this mean into local-sum + psum over ICI — the Horovod allreduce.
+        loss = jnp.mean(
+            cross_entropy(logits, batch["label"],
+                          self.cfg.train.label_smoothing)
+        )
+        accuracy = jnp.mean(
+            (jnp.argmax(logits, axis=-1) == batch["label"]).astype(jnp.float32)
+        )
+        aux: Dict[str, jnp.ndarray] = {"accuracy": accuracy}
+        if train:
+            aux["batch_stats"] = new_stats
+        return loss, aux
+
+
+def build_task(cfg: ExperimentConfig):
+    """Task registry keyed by model family."""
+    name = cfg.model.name
+    if name.startswith("resnet"):
+        return ClassificationTask(cfg)
+    if name.startswith("bert") or name.startswith("transformer_nmt") or \
+            name.startswith("maskrcnn"):
+        raise NotImplementedError(
+            f"task for {name!r} lands in a later milestone this round; "
+            f"resnet workloads are live"
+        )
+    raise KeyError(f"no task for model {name!r}")

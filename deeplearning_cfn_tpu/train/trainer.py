@@ -1,0 +1,234 @@
+"""The sharded trainer — the rebuild's canonical hot loop (SURVEY.md §4.4).
+
+Reference equivalents replaced here:
+- Horovod path (§4.2): per-GPU process, ``hvd.DistributedOptimizer`` wrapping
+  grads in a background-thread NCCL allreduce, ``BroadcastGlobalVariablesHook``.
+- KVStore path (§4.3): ``kvstore.push(grads) → server aggregates → pull``.
+
+Both become ONE jit-compiled program per step: forward, backward, gradient
+psum over ICI (inserted by XLA because the batch dim is sharded over the
+'data' mesh axis and the loss is a global mean), optimizer update — with zero
+host round-trips inside the step, donated buffers, and async dispatch so the
+input pipeline overlaps device compute.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ..config import ExperimentConfig
+from ..parallel.mesh import build_mesh, validate_batch
+from ..parallel.sharding import batch_sharding, replicated
+from .state import TrainState
+
+PyTree = Any
+Batch = Dict[str, np.ndarray]
+LossFn = Callable[..., Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]]
+
+
+class Trainer:
+    """Owns the compiled train/eval steps and the step loop.
+
+    Parameters
+    ----------
+    loss_fn:
+        ``loss_fn(params, batch_stats, batch, rng, train) -> (loss, aux)``
+        where ``aux`` is a dict of scalar metrics plus (when training) a
+        ``"batch_stats"`` entry with updated BN stats. The loss must be a
+        global-batch mean — that is what makes the compiler's psum correct.
+    """
+
+    def __init__(
+        self,
+        cfg: ExperimentConfig,
+        loss_fn: LossFn,
+        tx,
+        mesh: Optional[Mesh] = None,
+        spatial_dim: Optional[int] = None,
+        donate: bool = True,
+    ):
+        self.cfg = cfg
+        self.loss_fn = loss_fn
+        self.tx = tx
+        self.mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
+        validate_batch(cfg.train.global_batch, self.mesh)
+        self.spatial_dim = spatial_dim
+        self._train_step = None
+        self._eval_step = None
+        self._donate = donate
+
+    # -- sharding helpers ---------------------------------------------------
+
+    def batch_shardings(self, batch: Batch):
+        return {
+            k: batch_sharding(self.mesh, np.ndim(v), self.spatial_dim
+                              if np.ndim(v) >= 4 else None)
+            for k, v in batch.items()
+        }
+
+    def device_batch(self, batch: Batch, global_batch: Optional[int] = None):
+        """Stitch per-process host arrays into globally-sharded jax.Arrays."""
+        gb = global_batch or self.cfg.train.global_batch
+        out = {}
+        for k, v in batch.items():
+            sh = batch_sharding(self.mesh, v.ndim,
+                                self.spatial_dim if v.ndim >= 4 else None)
+            global_shape = (gb,) + tuple(v.shape[1:])
+            if jax.process_count() == 1:
+                out[k] = jax.device_put(v, sh)
+            else:
+                out[k] = jax.make_array_from_process_local_data(
+                    sh, v, global_shape
+                )
+        return out
+
+    # -- compiled steps -----------------------------------------------------
+
+    def _build_train_step(self):
+        tx = self.tx
+        loss_fn = self.loss_fn
+        ema_decay = self.cfg.train.ema_decay
+
+        def train_step(state: TrainState, batch: Batch, rng: jax.Array):
+            step_rng = jax.random.fold_in(rng, state.step)
+
+            def compute(params):
+                loss, aux = loss_fn(params, state.batch_stats, batch,
+                                    step_rng, True)
+                return loss, aux
+
+            (loss, aux), grads = jax.value_and_grad(compute, has_aux=True)(
+                state.params
+            )
+            new_stats = aux.pop("batch_stats", state.batch_stats)
+            new_state = state.apply_gradients(grads, tx, ema_decay)
+            new_state = new_state.replace(batch_stats=new_stats)
+            metrics = {"loss": loss, **aux}
+            metrics["grad_norm"] = optax_global_norm(grads)
+            return new_state, metrics
+
+        donate = (0,) if self._donate else ()
+        return jax.jit(train_step, donate_argnums=donate)
+
+    def _build_eval_step(self):
+        loss_fn = self.loss_fn
+
+        def eval_step(state: TrainState, batch: Batch):
+            params = state.ema_params if state.ema_params is not None \
+                else state.params
+            loss, aux = loss_fn(params, state.batch_stats, batch, None, False)
+            aux.pop("batch_stats", None)
+            return {"loss": loss, **aux}
+
+        return jax.jit(eval_step)
+
+    @property
+    def train_step(self):
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        return self._train_step
+
+    @property
+    def eval_step(self):
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        return self._eval_step
+
+    # -- loops --------------------------------------------------------------
+
+    def fit(
+        self,
+        state: TrainState,
+        train_iter: Iterator[Batch],
+        num_steps: int,
+        rng: jax.Array,
+        eval_iter_fn: Optional[Callable[[], Iterator[Batch]]] = None,
+        eval_every: int = 0,
+        eval_steps: int = 0,
+        hooks: Tuple[Callable[[int, TrainState, Dict[str, float]], None], ...] = (),
+        log_every: int = 50,
+        metrics_writer=None,
+        start_step: Optional[int] = None,
+    ) -> TrainState:
+        """The step loop. Dispatches async; only syncs on metrics at
+        ``log_every`` boundaries so device compute and host input prep overlap
+        (the reference achieved this with MXNet/TF's async engines; here it is
+        jax dispatch + explicit sync points)."""
+        step = int(state.step) if start_step is None else start_step
+        window_start = time.perf_counter()
+        window_examples = 0
+        last: Optional[tuple] = None
+        last_realized: Optional[Dict[str, float]] = None
+        gb = self.cfg.train.global_batch
+
+        while step < num_steps:
+            batch = next(train_iter)
+            dev_batch = self.device_batch(batch)
+            state, metrics = self.train_step(state, dev_batch, rng)
+            last = (step, metrics)
+            window_examples += gb
+            step += 1
+
+            if step % max(log_every, 1) == 0 or step >= num_steps:
+                # Sync point: realize the latest step's metrics.
+                last_step, last_metrics = last
+                realized = {
+                    k: float(v) for k, v in
+                    jax.device_get(last_metrics).items()
+                }
+                elapsed = time.perf_counter() - window_start
+                realized["examples_per_sec"] = window_examples / max(elapsed, 1e-9)
+                realized["examples_per_sec_per_device"] = (
+                    realized["examples_per_sec"] / self.mesh.devices.size
+                )
+                realized["step"] = last_step + 1
+                if metrics_writer is not None:
+                    metrics_writer.write(realized)
+                window_start = time.perf_counter()
+                window_examples = 0
+                last_realized = realized
+
+            # Hooks run every step (checkpoint cadence must not couple to
+            # log cadence); metrics arg is the last realized window, if any.
+            for hook in hooks:
+                hook(step, state, last_realized)
+
+            if (
+                eval_iter_fn is not None
+                and eval_every > 0
+                and step % eval_every == 0
+            ):
+                eval_metrics = self.evaluate(state, eval_iter_fn(), eval_steps)
+                if metrics_writer is not None:
+                    metrics_writer.write(
+                        {"step": step, **{f"eval_{k}": v
+                                          for k, v in eval_metrics.items()}}
+                    )
+        return state
+
+    def evaluate(self, state: TrainState, eval_iter: Iterator[Batch],
+                 max_steps: int = 0) -> Dict[str, float]:
+        totals: Dict[str, float] = {}
+        count = 0
+        eb = self.cfg.train.eval_batch or self.cfg.train.global_batch
+        for i, batch in enumerate(eval_iter):
+            if max_steps and i >= max_steps:
+                break
+            dev_batch = self.device_batch(batch, global_batch=eb)
+            metrics = jax.device_get(self.eval_step(state, dev_batch))
+            for k, v in metrics.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+            count += 1
+        return {k: v / max(count, 1) for k, v in totals.items()}
+
+
+def optax_global_norm(tree: PyTree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                        for x in leaves))

@@ -1,0 +1,30 @@
+"""Shared pytree helpers."""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+
+PyTree = Any
+
+
+def path_str(path: Tuple[Any, ...]) -> str:
+    """Render a jax tree path as 'a/b/0/c' — the canonical leaf name used by
+    both sharding rules and checkpoint manifests (must stay in sync)."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def flatten_with_names(tree: PyTree) -> Tuple[List[Tuple[str, Any]], Any]:
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return [(path_str(p), v) for p, v in flat], treedef

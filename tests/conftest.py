@@ -1,0 +1,37 @@
+"""Test harness: 8 fake CPU devices (SURVEY.md §5 strategy #2).
+
+The reference had no multi-node test harness at all; ours simulates every
+mesh/pjit/collective path single-process by forcing the CPU backend with 8
+virtual devices. Must run before jax initializes its backends, hence env
+setup at conftest import time.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# This image's sitecustomize pre-registers a TPU PJRT plugin before conftest
+# runs, so the env var alone is too late — switch in-process too. The CPU
+# client itself initializes lazily, after our XLA_FLAGS edit above.
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 fake devices, got {devs}"
+    return devs
+
+
+@pytest.fixture()
+def tmp_workdir(tmp_path):
+    return str(tmp_path)

@@ -1,0 +1,162 @@
+"""Checkpoint round-trip, atomicity, GC, and cross-topology restore."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.ckpt import (
+    CheckpointManager,
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from deeplearning_cfn_tpu.config import MeshConfig
+from deeplearning_cfn_tpu.parallel import batch_sharding, build_mesh, replicated
+
+
+def _tree():
+    return {
+        "params": {"w": jnp.arange(12.0).reshape(3, 4),
+                   "b": jnp.ones((4,))},
+        "step": jnp.asarray(7, jnp.int32),
+    }
+
+
+def test_roundtrip(tmp_workdir):
+    state = _tree()
+    save_checkpoint(tmp_workdir, 7, state)
+    assert latest_checkpoint(tmp_workdir) == 7
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, state)
+    restored, step = restore_checkpoint(tmp_workdir, zeros)
+    assert step == 7
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                  np.asarray(state["params"]["w"]))
+    assert int(restored["step"]) == 7
+
+
+def test_uncommitted_invisible(tmp_workdir):
+    state = _tree()
+    path = save_checkpoint(tmp_workdir, 3, state)
+    os.remove(os.path.join(path, "COMMIT"))
+    assert latest_checkpoint(tmp_workdir) is None
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(tmp_workdir, state)
+
+
+def test_keep_k_gc(tmp_workdir):
+    for step in [1, 2, 3, 4]:
+        save_checkpoint(tmp_workdir, step, _tree(), keep=2)
+    steps = sorted(
+        int(d[len("step_"):]) for d in os.listdir(tmp_workdir)
+        if d.startswith("step_")
+    )
+    assert steps == [3, 4]
+
+
+def test_sharded_save_restore(tmp_workdir, devices):
+    """A data-sharded array round-trips: each fake device's shard is written
+    and the global array is reassembled with the current shardings."""
+    mesh = build_mesh(MeshConfig(data=-1))
+    x = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+    sharded = jax.device_put(x, batch_sharding(mesh, 2))
+    state = {"x": sharded, "scalar": jnp.asarray(1.5)}
+    save_checkpoint(tmp_workdir, 1, state)
+
+    target = {"x": jnp.zeros((8, 4)), "scalar": jnp.asarray(0.0)}
+    shardings = {"x": batch_sharding(mesh, 2), "scalar": replicated(mesh)}
+    restored, _ = restore_checkpoint(tmp_workdir, target, shardings=shardings)
+    np.testing.assert_array_equal(np.asarray(restored["x"]), x)
+    assert restored["x"].sharding.spec == batch_sharding(mesh, 2).spec
+
+
+def test_cross_topology_restore(tmp_workdir, devices):
+    """Save sharded over 8 devices, restore replicated (topology change —
+    the resize-via-resume story, SURVEY.md §4.5)."""
+    mesh = build_mesh(MeshConfig(data=-1))
+    x = np.arange(16, dtype=np.float32).reshape(8, 2)
+    state = {"x": jax.device_put(x, batch_sharding(mesh, 2))}
+    save_checkpoint(tmp_workdir, 5, state)
+    restored, _ = restore_checkpoint(
+        tmp_workdir, {"x": jnp.zeros((8, 2))},
+        shardings={"x": replicated(mesh)},
+    )
+    np.testing.assert_array_equal(np.asarray(restored["x"]), x)
+
+
+def test_manager_async_and_resume(tmp_workdir):
+    mgr = CheckpointManager(tmp_workdir, every_steps=2, keep=2,
+                            async_write=True)
+    state = _tree()
+    for step in [1, 2, 3, 4]:
+        mgr.save(step, state)
+    mgr.wait()
+    assert latest_checkpoint(tmp_workdir) == 4
+    restored, step = mgr.restore_or_none(
+        jax.tree_util.tree_map(jnp.zeros_like, state)
+    )
+    assert step == 4
+    none_mgr = CheckpointManager(os.path.join(tmp_workdir, "empty"))
+    assert none_mgr.restore_or_none(state) == (None, None)
+
+
+def test_missing_leaf_raises(tmp_workdir):
+    save_checkpoint(tmp_workdir, 1, {"a": jnp.ones(3)})
+    with pytest.raises(KeyError):
+        restore_checkpoint(tmp_workdir, {"b": jnp.ones(3)})
+
+
+def test_multiprocess_shard_files_restore_correctly(tmp_workdir, devices):
+    """Regression (review finding): two processes saving shards with the same
+    leaf names must not collide — restore merges per-process manifests."""
+    import json
+
+    ckpt_dir = os.path.join(tmp_workdir, "step_00000001")
+    os.makedirs(ckpt_dir)
+    full = np.arange(8, dtype=np.float32).reshape(4, 2)
+    # Hand-write the on-disk format as two processes would produce it:
+    # p0 owns rows 0:2, p1 owns rows 2:4, identical npz keys "w::0".
+    with open(os.path.join(ckpt_dir, "manifest.json"), "w") as fh:
+        json.dump({"step": 1, "processes": 2, "leaves": {
+            "w": {"kind": "array", "shape": [4, 2], "dtype": "float32"}}}, fh)
+    for p, rows in [(0, (0, 2)), (1, (2, 4))]:
+        np.savez(os.path.join(ckpt_dir, f"shards_p{p}.tmp.npz"),
+                 **{"w::0": full[rows[0]:rows[1]]})
+        os.replace(os.path.join(ckpt_dir, f"shards_p{p}.tmp.npz"),
+                   os.path.join(ckpt_dir, f"shards_p{p}.npz"))
+        with open(os.path.join(ckpt_dir, f"manifest_p{p}.json"), "w") as fh:
+            json.dump({"process": p, "leaves": {"w": [
+                {"key": "w::0", "index": [[rows[0], rows[1]], [0, 2]]}]}}, fh)
+        with open(os.path.join(ckpt_dir, f"DONE_p{p}"), "w") as fh:
+            fh.write("1")
+    with open(os.path.join(ckpt_dir, "COMMIT"), "w") as fh:
+        fh.write("1")
+
+    restored, step = restore_checkpoint(tmp_workdir, {"w": jnp.zeros((4, 2))})
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(restored["w"]), full)
+
+
+def test_incomplete_shard_coverage_raises(tmp_workdir, devices):
+    """A checkpoint whose shard files don't cover the full array must raise,
+    not silently restore zeros."""
+    import json
+
+    ckpt_dir = os.path.join(tmp_workdir, "step_00000001")
+    os.makedirs(ckpt_dir)
+    with open(os.path.join(ckpt_dir, "manifest.json"), "w") as fh:
+        json.dump({"step": 1, "processes": 1, "leaves": {
+            "w": {"kind": "array", "shape": [4, 2], "dtype": "float32"}}}, fh)
+    np.savez(os.path.join(ckpt_dir, "shards_p0.tmp.npz"),
+             **{"w::0": np.ones((2, 2), np.float32)})
+    os.replace(os.path.join(ckpt_dir, "shards_p0.tmp.npz"),
+               os.path.join(ckpt_dir, "shards_p0.npz"))
+    with open(os.path.join(ckpt_dir, "manifest_p0.json"), "w") as fh:
+        json.dump({"process": 0, "leaves": {"w": [
+            {"key": "w::0", "index": [[0, 2], [0, 2]]}]}}, fh)
+    with open(os.path.join(ckpt_dir, "COMMIT"), "w") as fh:
+        fh.write("1")
+    with pytest.raises(ValueError, match="cover only"):
+        restore_checkpoint(tmp_workdir, {"w": jnp.zeros((4, 2))})

@@ -1,0 +1,91 @@
+"""Mesh/topology math over the 8-fake-device harness."""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.config import MeshConfig
+from deeplearning_cfn_tpu.parallel import (
+    MeshSpec,
+    batch_sharding,
+    build_mesh,
+    param_sharding_tree,
+    replicated,
+    shard_params,
+)
+from deeplearning_cfn_tpu.parallel.mesh import (
+    describe,
+    hosts_for_slice,
+    slice_chip_count,
+    validate_batch,
+)
+from jax.sharding import PartitionSpec as P
+
+
+def test_meshspec_resolve_auto_data(devices):
+    spec = MeshSpec.resolve(MeshConfig(data=-1, model=2), 8)
+    assert spec.data == 4 and spec.model == 2 and spec.num_devices == 8
+
+
+def test_meshspec_resolve_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        MeshSpec.resolve(MeshConfig(data=3, model=2), 8)
+    with pytest.raises(ValueError):
+        MeshSpec.resolve(MeshConfig(model=3), 8)
+
+
+def test_build_mesh_axes(devices):
+    mesh = build_mesh(MeshConfig(data=-1, model=2, spatial=2))
+    assert mesh.shape == {"data": 2, "spatial": 2, "model": 2}
+    assert mesh.devices.size == 8
+    assert "mesh[" in describe(mesh)
+
+
+def test_batch_sharding_places_batch_dim(devices):
+    mesh = build_mesh(MeshConfig(data=-1))
+    x = np.zeros((16, 4, 4, 3), np.float32)
+    sharded = jax.device_put(x, batch_sharding(mesh, x.ndim))
+    # Each of the 8 devices should hold 2 rows of the batch.
+    assert sharded.addressable_shards[0].data.shape == (2, 4, 4, 3)
+
+
+def test_spatial_sharding(devices):
+    mesh = build_mesh(MeshConfig(data=-1, spatial=2))
+    x = np.zeros((8, 16, 16, 3), np.float32)
+    sharded = jax.device_put(x, batch_sharding(mesh, x.ndim, spatial_dim=1))
+    assert sharded.addressable_shards[0].data.shape == (2, 8, 16, 3)
+
+
+def test_param_rules_and_replication(devices):
+    mesh = build_mesh(MeshConfig(data=-1, model=2))
+    params = {
+        "dense": {"kernel": np.zeros((16, 8), np.float32),
+                  "bias": np.zeros((8,), np.float32)},
+        "head": {"kernel": np.zeros((8, 4), np.float32)},
+    }
+    rules = [(r"dense/kernel", P(None, "model"))]
+    tree = param_sharding_tree(params, mesh, rules)
+    assert tree["dense"]["kernel"].spec == P(None, "model")
+    assert tree["dense"]["bias"].spec == P()
+    placed = shard_params(params, mesh, rules)
+    assert placed["dense"]["kernel"].addressable_shards[0].data.shape == (16, 4)
+
+
+def test_validate_batch(devices):
+    mesh = build_mesh(MeshConfig(data=-1))
+    validate_batch(16, mesh)
+    with pytest.raises(ValueError):
+        validate_batch(11, mesh)
+
+
+def test_slice_math():
+    assert slice_chip_count("v5p-256") == 256
+    assert hosts_for_slice("v5p-8") == 2
+    assert hosts_for_slice("v5p-256") == 64
+    with pytest.raises(ValueError):
+        slice_chip_count("bogus")
+
+
+def test_replicated_spec(devices):
+    mesh = build_mesh(MeshConfig())
+    assert replicated(mesh).spec == P()

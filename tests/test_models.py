@@ -1,0 +1,62 @@
+"""Model zoo: shapes, param counts, dtype policy."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.models import build_model
+
+
+def _param_count(params):
+    return sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(params))
+
+
+def test_resnet20_shapes_and_params():
+    model = build_model("resnet20", num_classes=10, dtype=jnp.float32)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    # He et al. ResNet-20 is ~0.27M params.
+    n = _param_count(variables["params"])
+    assert 0.2e6 < n < 0.35e6, n
+
+
+def test_resnet50_shapes_and_params():
+    model = build_model("resnet50", num_classes=1000, dtype=jnp.bfloat16)
+    x = jnp.zeros((1, 64, 64, 3))  # small spatial for test speed
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    n = _param_count(variables["params"])
+    # Canonical ResNet-50 ≈ 25.6M params.
+    assert 24e6 < n < 27e6, n
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (1, 1000)
+    assert logits.dtype == jnp.float32  # head forced to f32
+
+
+def test_batchnorm_stats_update():
+    model = build_model("resnet20", num_classes=10, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    _, mutated = model.apply(variables, x, train=True,
+                             mutable=["batch_stats"])
+    before = jax.tree_util.tree_leaves(variables["batch_stats"])
+    after = jax.tree_util.tree_leaves(mutated["batch_stats"])
+    assert any(not np.allclose(np.asarray(b), np.asarray(a))
+               for b, a in zip(before, after))
+
+
+def test_bn_params_stay_f32_under_bf16():
+    model = build_model("resnet50", num_classes=10, dtype=jnp.bfloat16)
+    x = jnp.zeros((1, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    flat = jax.tree_util.tree_leaves_with_path(variables["params"])
+    for path, leaf in flat:
+        assert leaf.dtype == jnp.float32, path
+
+
+def test_unknown_model_raises():
+    with pytest.raises(KeyError):
+        build_model("nonexistent", num_classes=2, dtype=jnp.float32)
