@@ -150,24 +150,33 @@ class DataPipeline:
         per_proc = self.source.size // self.pcount
         return idx[self.pidx * per_proc:(self.pidx + 1) * per_proc]
 
-    def _epoch_batches(self, epoch: int) -> Iterator[Batch]:
+    def _epoch_batches(self, epoch: int, start_batch: int = 0
+                       ) -> Iterator[Batch]:
         rng = np.random.RandomState(
             (self.seed + 1) * 7919 + epoch * 31 + self.pidx
         )
         idx = self._epoch_indices(epoch)
-        for start in range(0, self.steps_per_epoch * self.local_batch,
+        for start in range(start_batch * self.local_batch,
+                           self.steps_per_epoch * self.local_batch,
                            self.local_batch):
             batch = self.source.gather(idx[start:start + self.local_batch])
             if self.augment is not None:
                 batch = self.augment(batch, rng)
             yield batch
 
-    def epochs(self, start_epoch: int = 0) -> Iterator[Batch]:
-        """Infinite stream across epochs, optionally prefetched on a thread."""
+    def epochs(self, start_epoch: int = 0, skip_batches: int = 0
+               ) -> Iterator[Batch]:
+        """Infinite stream across epochs, optionally prefetched on a thread.
+
+        ``skip_batches`` fast-forwards within the first epoch (mid-epoch
+        checkpoint resume: the stream must continue where training stopped,
+        not replay the epoch head)."""
         def gen():
             epoch = start_epoch
+            skip = skip_batches
             while True:
-                yield from self._epoch_batches(epoch)
+                yield from self._epoch_batches(epoch, start_batch=skip)
+                skip = 0
                 epoch += 1
 
         if self.prefetch > 0:
@@ -186,8 +195,9 @@ def _thread_prefetch(it: Iterator[Batch], depth: int) -> Iterator[Batch]:
         try:
             for item in it:
                 q.put(item)
-        finally:
             q.put(_SENTINEL)
+        except BaseException as e:  # propagate loader crashes to consumer
+            q.put(("__prefetch_error__", e))
 
     t = threading.Thread(target=worker, daemon=True)
     t.start()
@@ -195,6 +205,9 @@ def _thread_prefetch(it: Iterator[Batch], depth: int) -> Iterator[Batch]:
         item = q.get()
         if item is _SENTINEL:
             return
+        if isinstance(item, tuple) and len(item) == 2 and \
+                item[0] == "__prefetch_error__":
+            raise RuntimeError("data pipeline worker crashed") from item[1]
         yield item
 
 

@@ -39,8 +39,11 @@ def build_schedule(
             base_lr, decay_steps, alpha=cfg.end_lr_factor
         )
     elif cfg.name == "step":
+        # Boundaries are fractions of TOTAL steps (config.py contract). The
+        # main schedule runs after the warmup join, whose step counter is
+        # offset by `warmup`, so subtract it here.
         boundaries = {
-            int(frac * decay_steps): factor
+            max(int(frac * total_steps) - warmup, 1): factor
             for frac, factor in zip(cfg.step_boundaries, cfg.step_factors)
         }
         # optax piecewise_constant_schedule multiplies by the *ratio* at each
@@ -53,14 +56,15 @@ def build_schedule(
         main = optax.piecewise_constant_schedule(base_lr, ratios)
     elif cfg.name == "rsqrt":
         # Transformer (Vaswani) schedule: d^-0.5 folded into base_lr;
-        # lr = base * min(step^-0.5, step * warmup^-1.5). Implemented directly.
+        # lr = base * w^-0.5 * min(s/w, (s/w)^-0.5). jnp ops only — this
+        # runs on a traced step inside the compiled train step.
         w = max(warmup, 1)
 
         def main(step):  # type: ignore[misc]
-            s = step + 1.0
-            return base_lr * (w ** -0.5) * (
-                (s / w) if s < w else (s / w) ** -0.5
-            )
+            import jax.numpy as jnp
+
+            s = (jnp.asarray(step, jnp.float32) + 1.0) / w
+            return base_lr * (w ** -0.5) * jnp.minimum(s, s ** -0.5)
 
         # rsqrt embeds its own warmup — skip the generic warmup join below.
         return main
@@ -96,7 +100,7 @@ def build_optimizer(
     elif name == "adamw":
         chain.append(
             optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
-                        weight_decay=cfg.weight_decay)
+                        weight_decay=cfg.weight_decay, mask=_non_bn_mask)
         )
     elif name == "adam":
         chain.append(optax.adam(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps))

@@ -40,7 +40,7 @@ def run_experiment(
                                 cfg.model.num_classes, seed=cfg.train.seed,
                                 train=True)
     eval_batch = cfg.train.eval_batch or cfg.train.global_batch
-    eval_pipe = build_pipeline(cfg.data, eval_batch // jax.process_count(),
+    eval_pipe = build_pipeline(cfg.data, local_batch_size(eval_batch, mesh),
                                cfg.model.num_classes, seed=cfg.train.seed,
                                train=False)
 
@@ -91,7 +91,8 @@ def run_experiment(
     eval_every = cfg.train.eval_every_steps or steps_per_epoch
     state = trainer.fit(
         state,
-        train_pipe.epochs(start_epoch=int(state.step) // steps_per_epoch),
+        train_pipe.epochs(start_epoch=int(state.step) // steps_per_epoch,
+                          skip_batches=int(state.step) % steps_per_epoch),
         num_steps=total_steps,
         rng=train_rng,
         eval_iter_fn=lambda: eval_pipe.one_epoch(),

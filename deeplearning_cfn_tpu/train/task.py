@@ -43,28 +43,38 @@ class ClassificationTask:
         self.model = build_model(
             cfg.model.name, cfg.model.num_classes, dtype, **cfg.model.kwargs
         )
-        if cfg.train.remat:
-            # Rematerialize the full forward: trade FLOPs for HBM.
-            self.model = jax.checkpoint(self.model)  # pragma: no cover
+        self.remat = cfg.train.remat
 
     def init(self, rng: jax.Array):
         shape = (1, self.cfg.data.image_size, self.cfg.data.image_size, 3)
         dummy = jnp.zeros(shape, jnp.float32)
         return self.model.init(rng, dummy, train=False)
 
+    def _forward_train(self, params, batch_stats, images):
+        variables = {"params": params}
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+        logits, mutated = self.model.apply(
+            variables, images, train=True, mutable=["batch_stats"]
+        )
+        return logits, mutated.get("batch_stats", batch_stats)
+
     def loss_fn(self, params: PyTree, batch_stats: PyTree,
                 batch: Dict[str, jnp.ndarray], rng, train: bool
                 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-        variables = {"params": params}
         has_stats = bool(batch_stats)
-        if has_stats:
-            variables["batch_stats"] = batch_stats
         if train and has_stats:
-            logits, mutated = self.model.apply(
-                variables, batch["image"], train=True, mutable=["batch_stats"]
-            )
-            new_stats = mutated["batch_stats"]
+            fwd = self._forward_train
+            if self.remat:
+                # Rematerialize the forward: trade FLOPs for HBM. Wraps the
+                # pure apply, not the Module (Modules aren't callables with
+                # init/apply after jax.checkpoint).
+                fwd = jax.checkpoint(fwd)
+            logits, new_stats = fwd(params, batch_stats, batch["image"])
         else:
+            variables = {"params": params}
+            if has_stats:
+                variables["batch_stats"] = batch_stats
             logits = self.model.apply(variables, batch["image"], train=False)
             new_stats = batch_stats
         # Global-batch mean: with the batch dim sharded over 'data', XLA turns

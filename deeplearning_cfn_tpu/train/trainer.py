@@ -20,6 +20,7 @@ from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 from jax.sharding import Mesh
 
 from ..config import ExperimentConfig
@@ -110,7 +111,9 @@ class Trainer:
             new_state = state.apply_gradients(grads, tx, ema_decay)
             new_state = new_state.replace(batch_stats=new_stats)
             metrics = {"loss": loss, **aux}
-            metrics["grad_norm"] = optax_global_norm(grads)
+            # Same implementation clip_by_global_norm uses, so the logged
+            # norm matches the clipping decision.
+            metrics["grad_norm"] = optax.global_norm(grads)
             return new_state, metrics
 
         donate = (0,) if self._donate else ()
@@ -227,8 +230,3 @@ class Trainer:
             count += 1
         return {k: v / max(count, 1) for k, v in totals.items()}
 
-
-def optax_global_norm(tree: PyTree) -> jnp.ndarray:
-    leaves = jax.tree_util.tree_leaves(tree)
-    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
-                        for x in leaves))

@@ -97,3 +97,27 @@ def test_factory_synthetic_fallback():
 def test_factory_unknown_raises():
     with pytest.raises(KeyError):
         build_pipeline(DataConfig(name="bogus"), 8, 10)
+
+
+def test_prefetch_propagates_worker_errors():
+    class BoomSource(ArraySource):
+        def gather(self, idx):
+            raise RuntimeError("disk on fire")
+
+    src = BoomSource.__new__(BoomSource)
+    src.arrays = {"x": np.arange(16, dtype=np.float32)}
+    src.size = 16
+    pipe = DataPipeline(src, local_batch=4, prefetch=2, process_index=0,
+                        process_count=1)
+    with pytest.raises(RuntimeError, match="worker crashed"):
+        next(pipe.epochs())
+
+
+def test_mid_epoch_resume_skips_consumed_batches():
+    src = ArraySource({"x": np.arange(32, dtype=np.float32)})
+    pipe = DataPipeline(src, local_batch=4, prefetch=0, process_index=0,
+                        process_count=1, seed=9)
+    full = [b["x"].tolist() for b in pipe.one_epoch(0)]
+    resumed_it = pipe.epochs(start_epoch=0, skip_batches=3)
+    resumed_first = next(resumed_it)["x"].tolist()
+    assert resumed_first == full[3]

@@ -61,3 +61,26 @@ def test_unknown_names_raise():
         build_optimizer(OptimizerConfig(name="bogus"), lambda s: 0.1)
     with pytest.raises(ValueError):
         build_schedule(ScheduleConfig(name="bogus"), 10, 8)
+
+
+def test_schedules_work_under_jit():
+    """Regression: schedules run on a traced step inside the compiled train
+    step — no Python branching on tracers allowed."""
+    import jax
+
+    for name, kw in [("rsqrt", dict(warmup_steps=10)),
+                     ("cosine", dict(warmup_steps=5)),
+                     ("step", dict(step_boundaries=(0.5,), step_factors=(0.1,)))]:
+        cfg = ScheduleConfig(name=name, base_lr=1.0, **kw)
+        sched = build_schedule(cfg, 100, 128)
+        val = jax.jit(sched)(jnp.asarray(50, jnp.int32))
+        assert np.isfinite(float(val))
+
+
+def test_step_boundaries_are_fractions_of_total_steps():
+    """Boundaries measured against TOTAL steps (incl. warmup), per config."""
+    cfg = ScheduleConfig(name="step", base_lr=1.0, warmup_steps=20,
+                         step_boundaries=(0.5,), step_factors=(0.1,))
+    sched = build_schedule(cfg, 100, 128)
+    assert float(sched(45)) == pytest.approx(1.0)
+    assert float(sched(55)) == pytest.approx(0.1)

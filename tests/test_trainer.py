@@ -164,3 +164,10 @@ def test_checkpoint_cadence_decoupled_from_log_cadence(tmp_workdir, devices):
                                "step_*", "COMMIT"))
     )
     assert "step_00000004" in ckpts and "step_00000008" in ckpts, ckpts
+
+
+def test_remat_flag_trains(tmp_workdir, devices):
+    cfg = _tiny_cfg(tmp_workdir, steps=2)
+    apply_overrides(cfg, ["train.remat=true"])
+    final = run_experiment(cfg)
+    assert np.isfinite(final["loss"])
