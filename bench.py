@@ -1,95 +1,36 @@
 """Headline benchmark: ResNet-50 training throughput, images/sec/chip.
 
-Runs the flagship north-star workload (BASELINE.json: "ResNet-50/ImageNet
-images/sec/chip") as a single-chip training-step microbenchmark on whatever
-accelerator is attached: full train step (fwd + bwd + SGD-LARS update) on
-synthetic ImageNet-shaped data, bf16 compute, donated buffers — the same
-compiled program the distributed trainer runs per-chip, minus the ICI
-collectives (single-chip bench per the driver contract).
-
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Driver contract: prints ONE JSON line {"metric", "value", "unit",
+"vs_baseline"}. Runs the flagship north-star workload (BASELINE.json:
+"ResNet-50/ImageNet images/sec/chip") as a single-chip training-step
+benchmark on whatever accelerator is attached, by delegating to the
+in-package harness (deeplearning_cfn_tpu/bench.py run_bench) — full train
+step (fwd + bwd + LARS update) on synthetic ImageNet-shaped data, bf16
+compute, donated buffers; sync via scalar device→host reads (some PJRT
+transports complete ready-events before execution finishes).
 
 vs_baseline: the reference repo publishes no numbers (BASELINE.json
 "published": {}), so the ratio is computed against the external context
 anchor recorded in BASELINE.md — TF+Horovod ResNet-50 at ~375 images/sec per
-V100 GPU (Horovod paper arXiv:1802.05799, ~3k img/s per 8-GPU node), the
-stack the reference's flagship workload ran on.
+V100 GPU (Horovod paper arXiv:1802.05799), the stack the reference's
+flagship workload ran on. Do NOT force the CPU backend here: this runs on
+the real chip.
 """
 
 from __future__ import annotations
 
 import json
-import time
-
-HOROVOD_V100_IMG_PER_SEC_PER_GPU = 375.0
 
 
 def main():
-    import jax
-    import jax.numpy as jnp
+    from deeplearning_cfn_tpu.bench import run_bench
 
-    from deeplearning_cfn_tpu.config import apply_overrides
-    from deeplearning_cfn_tpu.parallel.mesh import build_mesh
-    from deeplearning_cfn_tpu.config import MeshConfig
-    from deeplearning_cfn_tpu.presets import get_preset
-    from deeplearning_cfn_tpu.train import create_train_state
-    from deeplearning_cfn_tpu.train.optim import build_optimizer, build_schedule
-    from deeplearning_cfn_tpu.train.task import build_task
-    from deeplearning_cfn_tpu.train.trainer import Trainer
-
-    device = jax.devices()[0]
-    n_chips = 1
-    batch = 128
-    image = 224
-
-    cfg = get_preset("imagenet_resnet50")
-    apply_overrides(cfg, [
-        f"train.global_batch={batch}",
-        f"data.image_size={image}",
-        "data.prefetch=0",
-    ])
-    mesh = build_mesh(MeshConfig(data=1), devices=[device])
-
-    task = build_task(cfg)
-    sched = build_schedule(cfg.schedule, 1000, batch, 100)
-    tx = build_optimizer(cfg.optimizer, sched)
-    state = create_train_state(jax.random.PRNGKey(0), task.init, tx, mesh)
-    trainer = Trainer(cfg, task.loss_fn, tx, mesh=mesh)
-
-    import numpy as np
-
-    rng = np.random.RandomState(0)
-    host_batch = {
-        "image": rng.rand(batch, image, image, 3).astype(np.float32),
-        "label": rng.randint(0, 1000, batch).astype(np.int32),
-    }
-    dev_batch = trainer.device_batch(host_batch)
-    step_rng = jax.random.PRNGKey(1)
-
-    # Warmup: compile + 3 steps. NOTE: forced with a scalar device→host
-    # transfer, not block_until_ready — some PJRT transports complete the
-    # ready-event before execution finishes, which inflates throughput 30x+.
-    state, m = trainer.train_step(state, dev_batch, step_rng)
-    float(m["loss"])
-    for _ in range(3):
-        state, m = trainer.train_step(state, dev_batch, step_rng)
-    float(m["loss"])
-
-    iters = 20
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, m = trainer.train_step(state, dev_batch, step_rng)
-    float(m["loss"])  # force the whole dependent chain
-    dt = time.perf_counter() - t0
-
-    img_per_sec_per_chip = batch * iters / dt / n_chips
+    record = run_bench(preset="imagenet_resnet50", steps=20, warmup=4)
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
-        "value": round(img_per_sec_per_chip, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(
-            img_per_sec_per_chip / HOROVOD_V100_IMG_PER_SEC_PER_GPU, 3
-        ),
+        "value": record["value"],
+        "unit": record["unit"],
+        "vs_baseline": record["vs_baseline"],
     }))
 
 

@@ -1,0 +1,107 @@
+"""In-package benchmark harness: step-time/throughput for any preset.
+
+The reference's performance story was external (nccl-tests + the example
+scripts' own throughput prints); here measurement is a first-class verb
+(``dlcfn-tpu bench``). Root-level ``bench.py`` wraps the ResNet-50 flagship
+case of this harness for the driver contract.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+# External context anchor (BASELINE.md): TF+Horovod ResNet-50 on V100, the
+# stack the reference's flagship workload ran on (~375 img/s/GPU, Horovod
+# paper arXiv:1802.05799). The reference itself publishes no numbers.
+HOROVOD_V100_IMG_PER_SEC_PER_GPU = 375.0
+
+_UNITS = {
+    "cifar10_resnet20": "images/sec/chip",
+    "imagenet_resnet50": "images/sec/chip",
+    "maskrcnn_coco": "images/sec/chip",
+    "bert_base_wikipedia": "sequences/sec/chip",
+    "transformer_nmt_wmt": "sequences/sec/chip",
+}
+
+
+def run_bench(
+    preset: str = "imagenet_resnet50",
+    steps: int = 20,
+    global_batch: int = 0,
+    warmup: int = 4,
+    mesh=None,
+) -> Dict:
+    """Run ``steps`` timed train steps of ``preset`` on synthetic data and
+    return the one-line JSON record the driver expects."""
+    import jax
+    import numpy as np
+
+    from .config import MeshConfig, apply_overrides
+    from .data import build_pipeline
+    from .parallel.mesh import build_mesh, local_batch_size
+    from .presets import get_preset
+    from .runtime.profiling import StepTimer
+    from .train import create_train_state
+    from .train.optim import build_optimizer, build_schedule
+    from .train.task import build_task
+    from .train.trainer import Trainer
+
+    cfg = get_preset(preset)
+    if global_batch:
+        cfg.train.global_batch = global_batch
+    elif jax.device_count() == 1:
+        # Single-chip bench: a per-chip-sized batch, not the pod-sized one.
+        per_chip = {"imagenet_resnet50": 128, "cifar10_resnet20": 512,
+                    "bert_base_wikipedia": 32, "transformer_nmt_wmt": 64,
+                    "maskrcnn_coco": 1}.get(preset, 64)
+        cfg.train.global_batch = per_chip
+    apply_overrides(cfg, ["data.prefetch=0", "data.synthetic=true"])
+
+    mesh = mesh if mesh is not None else build_mesh(MeshConfig(data=-1))
+    n_chips = mesh.devices.size
+    gb = cfg.train.global_batch
+
+    task = build_task(cfg)
+    sched = build_schedule(cfg.schedule, max(steps * 10, 1000), gb, 100)
+    tx = build_optimizer(cfg.optimizer, sched)
+    state = create_train_state(jax.random.PRNGKey(0), task.init, tx, mesh,
+                               param_rules=getattr(task, "param_rules", ()))
+    trainer = Trainer(cfg, task.loss_fn, tx, mesh=mesh,
+                      spatial_dim=getattr(task, "spatial_dim", None))
+
+    pipe = build_pipeline(cfg.data, local_batch_size(gb, mesh),
+                          cfg.model.num_classes, seed=0, train=True)
+    host_batch = next(iter(pipe.one_epoch(0)))
+    dev_batch = trainer.device_batch(host_batch)
+    step_rng = jax.random.PRNGKey(1)
+
+    timer = StepTimer(warmup=0)
+    # Warmup (compile + cache); sync via a scalar device→host read — some
+    # PJRT transports complete ready-events before execution finishes.
+    for _ in range(max(warmup, 1)):
+        state, m = trainer.train_step(state, dev_batch, step_rng)
+    float(m["loss"])
+
+    for _ in range(steps):
+        timer.start()
+        state, m = trainer.train_step(state, dev_batch, step_rng)
+        float(m["loss"])
+        timer.stop()
+
+    summary = timer.summary(items_per_step=gb)
+    per_chip = gb / summary["mean_step_s"] / n_chips
+    unit = _UNITS.get(preset, "items/sec/chip")
+    record = {
+        "metric": f"{preset}_train_{unit.split('/')[0]}_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": unit,
+        # The V100 anchor is a ResNet-50/ImageNet number — a ratio against
+        # it is only meaningful for that preset.
+        "vs_baseline": round(per_chip / HOROVOD_V100_IMG_PER_SEC_PER_GPU, 3)
+        if preset == "imagenet_resnet50" else 0.0,
+        "steps": steps,
+        "global_batch": gb,
+        "n_chips": n_chips,
+        "mean_step_s": round(summary["mean_step_s"], 5),
+    }
+    return record

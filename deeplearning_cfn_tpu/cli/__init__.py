@@ -1,0 +1,12 @@
+"""User-facing CLI (L5) — the reference's ``stack create → train`` flow.
+
+Verbs (SURVEY.md §4.1/§4.4): ``stack create|delete|status|list`` manage the
+cluster (CFN stack → TPU pod slice), ``train`` launches a preset across it,
+``presets`` and ``info`` are introspection. ``--accelerator=tpu`` selects the
+TPU path per the task contract; ``--accelerator=cpu`` runs the same code
+single-host for local work.
+"""
+
+from .main import main
+
+__all__ = ["main"]

@@ -1,0 +1,305 @@
+"""`dlcfn-tpu` command implementation.
+
+The flow mirrors the reference end-to-end (SURVEY.md §4):
+
+    dlcfn-tpu stack create --name demo --slice-type v5p-32
+    dlcfn-tpu train --preset imagenet_resnet50 --stack demo
+    dlcfn-tpu stack delete demo
+
+`train` without a stack (or with --accelerator=cpu) runs single-host in this
+process — the equivalent of running a reference example script directly on
+one node.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import List, Optional
+
+from ..config import ExperimentConfig, StackConfig, apply_overrides
+from ..presets import get_preset, list_presets
+
+
+def _stack_cfg_from_args(args) -> StackConfig:
+    return StackConfig(
+        name=args.name,
+        accelerator=args.accelerator,
+        slice_type=args.slice_type,
+        zone=args.zone,
+        project=args.project,
+        runtime_version=args.runtime_version,
+        preemptible=args.preemptible,
+        provisioner=args.provisioner,
+        state_dir=args.state_dir,
+        create_timeout_s=args.create_timeout_s,
+    )
+
+
+def _cmd_stack_create(args) -> int:
+    from ..provision import ProvisionError, create_stack
+
+    cfg = _stack_cfg_from_args(args)
+    print(f"[dlcfn-tpu] creating stack {cfg.name!r} "
+          f"({cfg.slice_type}, zone {cfg.zone}, "
+          f"provisioner {cfg.provisioner}) ...")
+
+    def on_status(state):
+        counts = {}
+        for h in state.hosts:
+            counts[h.state] = counts.get(h.state, 0) + 1
+        print(f"[dlcfn-tpu]   hosts: {counts}")
+
+    try:
+        state = create_stack(cfg, on_status=on_status)
+    except ProvisionError as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    print(f"[dlcfn-tpu] stack {state.name!r} CREATE_COMPLETE: "
+          f"{len(state.hosts)} hosts, hostfile {state.hostfile}")
+    return 0
+
+
+def _cmd_stack_delete(args) -> int:
+    from ..provision import ProvisionError, StackStore, delete_stack
+
+    try:
+        delete_stack(args.name, store=StackStore(args.state_dir))
+    except (KeyError, ProvisionError) as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    print(f"[dlcfn-tpu] stack {args.name!r} deleted")
+    return 0
+
+
+def _cmd_stack_status(args) -> int:
+    from ..provision import StackStore
+
+    store = StackStore(args.state_dir)
+    state = store.load_or_none(args.name)
+    if state is None:
+        print(f"[dlcfn-tpu] no such stack {args.name!r}", file=sys.stderr)
+        return 1
+    print(json.dumps(state.to_dict(), indent=2))
+    return 0
+
+
+def _cmd_stack_list(args) -> int:
+    from ..provision import StackStore
+
+    store = StackStore(args.state_dir)
+    stacks = store.list()
+    if not stacks:
+        print("[dlcfn-tpu] no stacks")
+        return 0
+    for s in stacks:
+        print(f"{s.name:20s} {s.slice_type:10s} {s.status.value:20s} "
+              f"{len(s.hosts)} hosts  zone={s.zone}")
+    return 0
+
+
+def _cmd_presets(args) -> int:
+    for name in list_presets():
+        cfg = get_preset(name)
+        print(f"{name:24s} model={cfg.model.name:20s} "
+              f"data={cfg.data.name:16s} slice={cfg.stack.slice_type}")
+    return 0
+
+
+def _cmd_show_config(args) -> int:
+    cfg = apply_overrides(get_preset(args.preset), args.overrides)
+    print(cfg.to_json())
+    return 0
+
+
+def _cmd_info(args) -> int:
+    import jax
+
+    from ..parallel.mesh import build_mesh, describe
+
+    print(f"jax {jax.__version__}, backend {jax.default_backend()}")
+    print(f"devices: {jax.device_count()} total, "
+          f"{jax.local_device_count()} local, "
+          f"process {jax.process_index()}/{jax.process_count()}")
+    print(describe(build_mesh()))
+    return 0
+
+
+def _cmd_train(args) -> int:
+    cfg = apply_overrides(get_preset(args.preset), args.overrides)
+    if args.accelerator:
+        cfg.stack.accelerator = args.accelerator
+
+    if args.stack:
+        return _train_on_stack(args, cfg)
+
+    # Single-host path: run in-process, exactly like executing a reference
+    # example script on one node.
+    if cfg.stack.accelerator == "cpu":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ..train.run import run_experiment
+
+    final = run_experiment(cfg, max_steps=args.max_steps)
+    print(f"[dlcfn-tpu] final metrics: "
+          f"{ {k: round(v, 4) for k, v in final.items()} }")
+    return 0
+
+
+def _train_on_stack(args, cfg: ExperimentConfig) -> int:
+    """Multi-host path: fan the worker module to every stack host (L2)."""
+    from ..launch import JobLauncher, LocalTransport, SshTransport
+    from ..provision import StackStore
+    from ..runtime.cluster import ClusterSpec
+    from ..provision.topology import slice_topology
+
+    store = StackStore(args.state_dir)
+    state = store.load_or_none(args.stack)
+    if state is None:
+        print(f"[dlcfn-tpu] no such stack {args.stack!r} — "
+              "run `dlcfn-tpu stack create` first", file=sys.stderr)
+        return 1
+    if not state.ready:
+        print(f"[dlcfn-tpu] stack {args.stack!r} is {state.status.value}, "
+              "not CREATE_COMPLETE", file=sys.stderr)
+        return 1
+
+    topo = slice_topology(state.slice_type)
+    spec = ClusterSpec(hosts=state.host_addresses(),
+                       chips_per_host=topo.chips_per_host,
+                       hostfile=state.hostfile)
+    worker_argv = [
+        sys.executable, "-m", "deeplearning_cfn_tpu.train.worker",
+        "--preset", args.preset,
+    ]
+    if args.max_steps is not None:
+        worker_argv += ["--max-steps", str(args.max_steps)]
+    worker_argv += list(args.overrides)
+
+    # Dry-run stacks simulate hosts as local processes on CPU.
+    if state.provisioner == "dryrun":
+        transport = LocalTransport()
+        extra_env = {"JAX_PLATFORMS": "cpu"}
+    else:
+        transport = SshTransport()
+        extra_env = {}
+
+    log_dir = os.path.join(cfg.workdir, args.preset, "logs")
+    launcher = JobLauncher(transport=transport,
+                           max_restarts=args.max_restarts)
+
+    def on_failure(idx, host):
+        print(f"[dlcfn-tpu] host {idx} ({host}) FAILED — killing job, "
+              "will resume from last checkpoint", file=sys.stderr)
+
+    result = launcher.run(spec, worker_argv, log_dir,
+                          extra_env=extra_env, on_failure=on_failure)
+    if result.success:
+        print(f"[dlcfn-tpu] job finished "
+              f"(restarts={result.restarts}, logs in {result.log_dir})")
+        return 0
+    print(f"[dlcfn-tpu] job FAILED after {result.restarts} restarts "
+          f"(exit codes {result.exit_codes}, logs in {result.log_dir})",
+          file=sys.stderr)
+    return 1
+
+
+def _cmd_bench(args) -> int:
+    from ..bench import run_bench
+
+    line = run_bench(preset=args.preset, steps=args.steps,
+                     global_batch=args.global_batch)
+    print(json.dumps(line))
+    return 0
+
+
+def _add_stack_args(p: argparse.ArgumentParser) -> None:
+    defaults = StackConfig()
+    p.add_argument("--state-dir", default=defaults.state_dir)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dlcfn-tpu",
+        description="TPU-native deeplearning-cfn: stack create → train",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    # stack ------------------------------------------------------------------
+    stack = sub.add_parser("stack", help="cluster lifecycle")
+    ssub = stack.add_subparsers(dest="stack_command", required=True)
+
+    defaults = StackConfig()
+    sc = ssub.add_parser("create", help="create a TPU pod-slice stack")
+    sc.add_argument("--name", default=defaults.name)
+    sc.add_argument("--slice-type", default=defaults.slice_type)
+    sc.add_argument("--zone", default=defaults.zone)
+    sc.add_argument("--project", default=defaults.project)
+    sc.add_argument("--runtime-version", default=defaults.runtime_version)
+    sc.add_argument("--accelerator", default=defaults.accelerator,
+                    choices=["tpu", "cpu"])
+    sc.add_argument("--preemptible", action="store_true")
+    sc.add_argument("--provisioner", default=defaults.provisioner,
+                    choices=["auto", "gcp", "dryrun"])
+    sc.add_argument("--create-timeout-s", type=int,
+                    default=defaults.create_timeout_s)
+    _add_stack_args(sc)
+    sc.set_defaults(fn=_cmd_stack_create)
+
+    sd = ssub.add_parser("delete", help="delete a stack")
+    sd.add_argument("name")
+    _add_stack_args(sd)
+    sd.set_defaults(fn=_cmd_stack_delete)
+
+    st = ssub.add_parser("status", help="describe a stack")
+    st.add_argument("name")
+    _add_stack_args(st)
+    st.set_defaults(fn=_cmd_stack_status)
+
+    sl = ssub.add_parser("list", help="list stacks")
+    _add_stack_args(sl)
+    sl.set_defaults(fn=_cmd_stack_list)
+
+    # train ------------------------------------------------------------------
+    tr = sub.add_parser("train", help="train a preset (locally or on a stack)")
+    tr.add_argument("--preset", required=True)
+    tr.add_argument("--stack", default="",
+                    help="stack name to fan out to (empty = this host only)")
+    tr.add_argument("--accelerator", default="", choices=["", "tpu", "cpu"])
+    tr.add_argument("--max-steps", type=int, default=None)
+    tr.add_argument("--max-restarts", type=int, default=2)
+    tr.add_argument("overrides", nargs="*",
+                    help="config overrides, e.g. train.global_batch=256")
+    _add_stack_args(tr)
+    tr.set_defaults(fn=_cmd_train)
+
+    # introspection ----------------------------------------------------------
+    pr = sub.add_parser("presets", help="list training presets")
+    pr.set_defaults(fn=_cmd_presets)
+
+    co = sub.add_parser("config", help="print a preset's resolved config")
+    co.add_argument("--preset", required=True)
+    co.add_argument("overrides", nargs="*")
+    co.set_defaults(fn=_cmd_show_config)
+
+    inf = sub.add_parser("info", help="device / mesh info")
+    inf.set_defaults(fn=_cmd_info)
+
+    be = sub.add_parser("bench", help="run the benchmark harness")
+    be.add_argument("--preset", default="cifar10_resnet20")
+    be.add_argument("--steps", type=int, default=30)
+    be.add_argument("--global-batch", type=int, default=0)
+    be.set_defaults(fn=_cmd_bench)
+
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,226 @@
+"""Multi-host job launcher: fan-out, log aggregation, failure watch, resume.
+
+Replaces the reference's `mpirun -np N -hostfile ...` / `launch.py --launcher
+ssh` hot path (SURVEY.md §4.2). Transports abstract "start this argv on that
+host": SSH for real TPU-VM slices (one initial fan-out — no per-step SSH
+traffic, unlike the reference's always-on mesh), local subprocesses for
+simulation and tests. The watch loop implements the contract SURVEY.md §6
+specifies for failure detection: any host death kills the job and restarts
+it from the last checkpoint (training code auto-resumes via
+CheckpointConfig.resume), up to ``max_restarts`` times.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, IO, List, Optional, Sequence
+
+from ..runtime.cluster import ClusterSpec, cluster_env
+
+
+class Transport:
+    """Starts a process on a host; returns the local Popen handle."""
+
+    def popen(self, host: str, argv: Sequence[str], env: Dict[str, str],
+              stdout: IO, cwd: Optional[str] = None) -> subprocess.Popen:
+        raise NotImplementedError
+
+
+class LocalTransport(Transport):
+    """Run every 'host' as a local subprocess — the simulation/test backend
+    (all ranks on one machine, the env contract still per-rank)."""
+
+    def popen(self, host, argv, env, stdout, cwd=None):
+        full_env = {**os.environ, **env}
+        return subprocess.Popen(
+            list(argv), env=full_env, stdout=stdout,
+            stderr=subprocess.STDOUT, cwd=cwd,
+            start_new_session=True,
+        )
+
+
+class SshTransport(Transport):
+    """Run on a real slice host over SSH (BatchMode: keys must already be in
+    place — TPU-VM creation installs them, unlike the reference which had to
+    build its own key mesh during bootstrap)."""
+
+    def __init__(self, ssh_args: Sequence[str] = ()):
+        self.ssh_args = list(ssh_args)
+
+    def popen(self, host, argv, env, stdout, cwd=None):
+        exports = " ".join(
+            f"export {k}={shlex.quote(v)};" for k, v in env.items()
+        )
+        cd = f"cd {shlex.quote(cwd)}; " if cwd else ""
+        remote = f"{exports} {cd}{' '.join(shlex.quote(a) for a in argv)}"
+        # -tt allocates a remote tty so killing the local ssh client tears
+        # the remote command down too (HUP on tty loss) — without it,
+        # _kill_all would orphan remote workers that keep holding the chips.
+        cmd = ["ssh", "-tt", "-o", "BatchMode=yes",
+               "-o", "StrictHostKeyChecking=accept-new",
+               *self.ssh_args, host, remote]
+        return subprocess.Popen(cmd, stdout=stdout,
+                                stderr=subprocess.STDOUT,
+                                stdin=subprocess.DEVNULL,
+                                start_new_session=True)
+
+
+@dataclasses.dataclass
+class JobResult:
+    success: bool
+    restarts: int
+    exit_codes: List[int]
+    log_dir: str
+
+
+class _HostProc:
+    def __init__(self, index: int, host: str, proc: subprocess.Popen,
+                 log_path: str, log_file: IO):
+        self.index = index
+        self.host = host
+        self.proc = proc
+        self.log_path = log_path
+        self.log_file = log_file
+
+
+class JobLauncher:
+    """Fans one argv to all hosts and babysits the job.
+
+    Parameters
+    ----------
+    transport: how to reach hosts (SshTransport on real slices).
+    max_restarts: full-job restarts after a host failure before giving up.
+        Restarted training processes resume from the latest checkpoint —
+        the auto-resume contract the reference left manual.
+    tail_rank0: stream host 0's log lines to our stdout (the reference user
+        watched mpirun's merged output; per-host logs stay on disk).
+    """
+
+    def __init__(
+        self,
+        transport: Optional[Transport] = None,
+        max_restarts: int = 2,
+        poll_interval_s: float = 0.2,
+        tail_rank0: bool = True,
+    ):
+        self.transport = transport or LocalTransport()
+        self.max_restarts = max_restarts
+        self.poll_interval_s = poll_interval_s
+        self.tail_rank0 = tail_rank0
+
+    # -- single attempt -----------------------------------------------------
+
+    def _start_all(self, spec: ClusterSpec, argv: Sequence[str],
+                   log_dir: str, attempt: int,
+                   extra_env: Dict[str, str], cwd: Optional[str]
+                   ) -> List[_HostProc]:
+        procs = []
+        for i, host in enumerate(spec.hosts):
+            env = {**cluster_env(spec, i), **extra_env}
+            log_path = os.path.join(log_dir,
+                                    f"attempt{attempt}-host{i}.log")
+            log_file = open(log_path, "ab", buffering=0)
+            proc = self.transport.popen(host, argv, env, log_file, cwd=cwd)
+            procs.append(_HostProc(i, host, proc, log_path, log_file))
+        return procs
+
+    def _kill_all(self, procs: List[_HostProc]) -> None:
+        for hp in procs:
+            if hp.proc.poll() is None:
+                try:
+                    # Kill the whole session so grandchildren die too.
+                    os.killpg(hp.proc.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError, OSError):
+                    hp.proc.terminate()
+        deadline = time.time() + 10
+        for hp in procs:
+            try:
+                hp.proc.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(hp.proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError, OSError):
+                    hp.proc.kill()
+                hp.proc.wait()
+
+    def _tail(self, path: str, stop: threading.Event) -> None:
+        with open(path, "rb") as fh:
+            while not stop.is_set():
+                line = fh.readline()
+                if line:
+                    sys.stdout.write(
+                        line.decode("utf-8", errors="replace"))
+                    sys.stdout.flush()
+                else:
+                    time.sleep(0.1)
+            for line in fh:  # drain
+                sys.stdout.write(line.decode("utf-8", errors="replace"))
+            sys.stdout.flush()
+
+    def _run_attempt(self, spec, argv, log_dir, attempt, extra_env, cwd,
+                     on_failure: Optional[Callable[[int, str], None]]
+                     ) -> List[int]:
+        procs = self._start_all(spec, argv, log_dir, attempt, extra_env, cwd)
+        stop = threading.Event()
+        tailer = None
+        if self.tail_rank0:
+            tailer = threading.Thread(
+                target=self._tail, args=(procs[0].log_path, stop),
+                daemon=True)
+            tailer.start()
+        try:
+            while True:
+                codes = [hp.proc.poll() for hp in procs]
+                failed = [hp for hp, c in zip(procs, codes)
+                          if c is not None and c != 0]
+                if failed:
+                    # Failure detected: kill the survivors (a partial world
+                    # would hang in collectives forever — the reference's
+                    # Horovod jobs did exactly that on node loss).
+                    if on_failure:
+                        for hp in failed:
+                            on_failure(hp.index, hp.host)
+                    self._kill_all(procs)
+                    return [hp.proc.returncode if hp.proc.returncode
+                            is not None else -1 for hp in procs]
+                if all(c == 0 for c in codes):
+                    return [0] * len(procs)
+                time.sleep(self.poll_interval_s)
+        finally:
+            stop.set()
+            if tailer is not None:
+                tailer.join(timeout=5)
+            for hp in procs:
+                hp.log_file.close()
+
+    # -- public -------------------------------------------------------------
+
+    def run(
+        self,
+        spec: ClusterSpec,
+        argv: Sequence[str],
+        log_dir: str,
+        extra_env: Optional[Dict[str, str]] = None,
+        cwd: Optional[str] = None,
+        on_failure: Optional[Callable[[int, str], None]] = None,
+    ) -> JobResult:
+        """Run ``argv`` on every host until success or restart budget spent."""
+        os.makedirs(log_dir, exist_ok=True)
+        extra_env = extra_env or {}
+        attempt = 0
+        while True:
+            codes = self._run_attempt(spec, argv, log_dir, attempt,
+                                      extra_env, cwd, on_failure)
+            if all(c == 0 for c in codes):
+                return JobResult(True, attempt, codes, log_dir)
+            if attempt >= self.max_restarts:
+                return JobResult(False, attempt, codes, log_dir)
+            attempt += 1
+            time.sleep(min(2.0 ** attempt, 10.0))  # backoff before retry

@@ -1,0 +1,42 @@
+"""Runtime layer (L1) — cluster assembly without the SSH dance.
+
+The reference's bootstrap layer (SURVEY.md §4.1) made every node converge on a
+file + env-var contract: a hostfile at ``$DEEPLEARNING_WORKERS_PATH``, counts
+in ``$DEEPLEARNING_WORKERS_COUNT`` / ``$DEEPLEARNING_WORKER_GPU_COUNT``, and a
+passwordless SSH mesh so MPI/KVStore launchers could fan out. On TPU the
+hosts of a pod slice already share topology through the TPU runtime, so this
+layer shrinks to (a) the same contract, TPU-named, and (b) a
+``jax.distributed`` rendezvous replacing MPI's.
+
+Env-var contract (mirrors the reference's ``DEEPLEARNING_*`` names):
+
+===========================  ==================================================
+``DLCFN_WORKERS_PATH``       hostfile path — one host address per line
+``DLCFN_WORKERS_COUNT``      number of hosts (processes) in the job
+``DLCFN_WORKER_CHIP_COUNT``  accelerator chips per host
+``DLCFN_COORDINATOR``        ``host:port`` of process 0 (rendezvous address)
+``DLCFN_PROCESS_ID``         this host's rank in [0, WORKERS_COUNT)
+===========================  ==================================================
+"""
+
+from .cluster import (
+    ClusterSpec,
+    cluster_env,
+    current_cluster,
+    initialize,
+    read_hostfile,
+    write_hostfile,
+)
+from .profiling import StepTimer, start_profiler_server, trace_steps
+
+__all__ = [
+    "ClusterSpec",
+    "cluster_env",
+    "current_cluster",
+    "initialize",
+    "read_hostfile",
+    "write_hostfile",
+    "StepTimer",
+    "start_profiler_server",
+    "trace_steps",
+]

@@ -1,0 +1,150 @@
+"""Tests for L5: the `stack create → train` CLI flow — the reference's
+user-facing contract (SURVEY.md §4.1/§4.4), exercised end-to-end against the
+dry-run control plane."""
+
+import json
+import sys
+
+import pytest
+
+from deeplearning_cfn_tpu.cli import main
+
+
+def test_presets_lists_all_five(capsys):
+    assert main(["presets"]) == 0
+    out = capsys.readouterr().out
+    for name in ["cifar10_resnet20", "imagenet_resnet50",
+                 "bert_base_wikipedia", "maskrcnn_coco",
+                 "transformer_nmt_wmt"]:
+        assert name in out
+
+
+def test_config_shows_resolved_preset_with_overrides(capsys):
+    assert main(["config", "--preset", "cifar10_resnet20",
+                 "train.global_batch=64"]) == 0
+    cfg = json.loads(capsys.readouterr().out)
+    assert cfg["model"]["name"] == "resnet20"
+    assert cfg["train"]["global_batch"] == 64
+
+
+def test_config_rejects_unknown_override():
+    with pytest.raises(KeyError):
+        main(["config", "--preset", "cifar10_resnet20", "train.nope=1"])
+
+
+def test_stack_lifecycle(tmp_path, capsys):
+    state_dir = str(tmp_path)
+    assert main(["stack", "create", "--name", "clitest",
+                 "--slice-type", "v5p-8", "--provisioner", "dryrun",
+                 "--state-dir", state_dir]) == 0
+    out = capsys.readouterr().out
+    assert "CREATE_COMPLETE" in out
+
+    assert main(["stack", "status", "clitest",
+                 "--state-dir", state_dir]) == 0
+    status = json.loads(capsys.readouterr().out)
+    assert status["status"] == "CREATE_COMPLETE"
+    assert len(status["hosts"]) == 2
+
+    assert main(["stack", "list", "--state-dir", state_dir]) == 0
+    assert "clitest" in capsys.readouterr().out
+
+    assert main(["stack", "delete", "clitest",
+                 "--state-dir", state_dir]) == 0
+    assert main(["stack", "status", "clitest",
+                 "--state-dir", state_dir]) == 1
+
+
+def test_stack_status_missing(tmp_path):
+    assert main(["stack", "status", "nope",
+                 "--state-dir", str(tmp_path)]) == 1
+
+
+def test_train_requires_existing_ready_stack(tmp_path):
+    assert main(["train", "--preset", "cifar10_resnet20",
+                 "--stack", "ghost", "--state-dir", str(tmp_path)]) == 1
+
+
+def test_train_local_inprocess(tmp_path, capsys):
+    """`train` without a stack runs single-host in-process — the 'run the
+    example script directly' path."""
+    rc = main([
+        "train", "--preset", "cifar10_resnet20",
+        "--max-steps", "2",
+        "--state-dir", str(tmp_path),
+        f"workdir={tmp_path}/work",
+        "train.global_batch=32",
+        "data.num_train_examples=64",
+        "data.num_eval_examples=32",
+        "data.prefetch=0",
+        "checkpoint.async_write=false",
+        "train.log_every_steps=1",
+    ])
+    assert rc == 0
+    assert "final metrics" in capsys.readouterr().out
+
+
+def test_train_on_dryrun_stack_fans_out_worker(tmp_path, capsys):
+    """Full `stack create → train` flow: a 1-host dry-run stack, the worker
+    module fanned out as a real subprocess via LocalTransport."""
+    state_dir = str(tmp_path / "stacks")
+    assert main(["stack", "create", "--name", "trainstack",
+                 "--slice-type", "v5p-4", "--provisioner", "dryrun",
+                 "--state-dir", state_dir]) == 0
+    capsys.readouterr()
+    rc = main([
+        "train", "--preset", "cifar10_resnet20",
+        "--stack", "trainstack",
+        "--state-dir", state_dir,
+        "--max-steps", "2",
+        f"workdir={tmp_path}/work",
+        "train.global_batch=32",
+        "data.num_train_examples=64",
+        "data.num_eval_examples=32",
+        "data.prefetch=0",
+        "checkpoint.async_write=false",
+        "train.log_every_steps=1",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "job finished" in out
+    logs = list((tmp_path / "work" / "cifar10_resnet20" / "logs").iterdir())
+    assert any("attempt0-host0.log" == p.name for p in logs)
+
+
+def test_train_on_multihost_dryrun_stack(tmp_path, capsys):
+    """The keystone cluster simulation: a 2-host dry-run stack (v5p-8),
+    `train --stack` fans TWO worker processes that rendezvous over loopback
+    via jax.distributed and run real data-parallel steps across 16 fake
+    devices — the whole L0→L4 stack with zero real TPUs."""
+    state_dir = str(tmp_path / "stacks")
+    assert main(["stack", "create", "--name", "mh",
+                 "--slice-type", "v5p-8", "--provisioner", "dryrun",
+                 "--state-dir", state_dir]) == 0
+    capsys.readouterr()
+    rc = main([
+        "train", "--preset", "cifar10_resnet20",
+        "--stack", "mh",
+        "--state-dir", state_dir,
+        "--max-steps", "2",
+        f"workdir={tmp_path}/work",
+        "train.global_batch=32",
+        "data.num_train_examples=64",
+        "data.num_eval_examples=32",
+        "train.eval_batch=32",
+        "data.prefetch=0",
+        "checkpoint.async_write=false",
+        "train.log_every_steps=1",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    log_dir = tmp_path / "work" / "cifar10_resnet20" / "logs"
+    host0 = (log_dir / "attempt0-host0.log").read_text()
+    assert "2 processes" in host0, host0  # both ranks joined the mesh
+    assert (log_dir / "attempt0-host1.log").exists()
+
+
+def test_entry_point_matches_pyproject():
+    # pyproject [project.scripts] points at cli.main:main — keep them wired.
+    from deeplearning_cfn_tpu.cli.main import main as m
+    assert callable(m)

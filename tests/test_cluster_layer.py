@@ -1,0 +1,235 @@
+"""Tests for L0/L1: topology catalog, stack store, provisioner, runtime
+contract (SURVEY.md §5 tiers 1–2 — the provisioner fixture strategy)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deeplearning_cfn_tpu.config import StackConfig
+from deeplearning_cfn_tpu.provision import (
+    DryRunProvisioner,
+    ProvisionError,
+    StackStatus,
+    StackStore,
+    create_stack,
+    delete_stack,
+    slice_topology,
+)
+from deeplearning_cfn_tpu.runtime import cluster as rt
+
+
+# -- topology ---------------------------------------------------------------
+
+
+def test_slice_topology_v5p():
+    t = slice_topology("v5p-256")
+    assert t.num_chips == 256
+    assert t.chips_per_host == 4
+    assert t.num_hosts == 64
+    assert len(t.ici_mesh) == 3
+    prod = 1
+    for d in t.ici_mesh:
+        prod *= d
+    assert prod == 256
+
+
+def test_slice_topology_generations():
+    assert slice_topology("v4-8").num_hosts == 2
+    assert slice_topology("v5e-16").chips_per_host == 8
+    assert slice_topology("v5e-16").num_hosts == 2
+    # v2/v3 suffix counts TensorCores (2/chip).
+    assert slice_topology("v3-8").num_chips == 4
+    assert slice_topology("v3-8").num_hosts == 1
+
+
+@pytest.mark.parametrize("bad", ["v5p", "x5-8", "v5p-0", "v99-8", "v5e-9999"])
+def test_slice_topology_rejects(bad):
+    with pytest.raises(ValueError):
+        slice_topology(bad)
+
+
+# -- stack store ------------------------------------------------------------
+
+
+def test_stack_store_roundtrip(tmp_path):
+    store = StackStore(str(tmp_path))
+    cfg = StackConfig(name="t1", slice_type="v5p-8", provisioner="dryrun")
+    state = DryRunProvisioner().create(cfg)
+    store.save(state)
+    loaded = store.load("t1")
+    assert loaded.name == "t1"
+    assert loaded.slice_type == "v5p-8"
+    assert loaded.status == StackStatus.CREATE_IN_PROGRESS
+    assert len(loaded.hosts) == 2
+    assert [s.name for s in store.list()] == ["t1"]
+    store.delete("t1")
+    assert store.load_or_none("t1") is None
+
+
+def test_stack_store_rejects_bad_names(tmp_path):
+    store = StackStore(str(tmp_path))
+    for bad in ["", "../evil", ".hidden"]:
+        with pytest.raises(ValueError):
+            store._path(bad)
+
+
+# -- provisioner flows ------------------------------------------------------
+
+
+def _mk_cfg(tmp_path, **kw):
+    defaults = dict(name="demo", slice_type="v5p-8", provisioner="dryrun",
+                    state_dir=str(tmp_path), create_timeout_s=60)
+    defaults.update(kw)
+    return StackConfig(**defaults)
+
+
+def test_create_stack_happy_path(tmp_path):
+    cfg = _mk_cfg(tmp_path)
+    seen = []
+    state = create_stack(cfg, provisioner=DryRunProvisioner(ready_after_polls=3),
+                         on_status=lambda s: seen.append(
+                             {h.state for h in s.hosts}),
+                         _sleep=lambda s: None)
+    assert state.status == StackStatus.CREATE_COMPLETE
+    assert state.ready
+    assert {h.state for h in state.hosts} == {"READY"}
+    # Staged readiness was observed (CREATING before READY).
+    assert {"CREATING"} in seen
+    # Hostfile written with one address per host — the reference's
+    # $DEEPLEARNING_WORKERS_PATH contract.
+    hosts = rt.read_hostfile(state.hostfile)
+    assert len(hosts) == 2
+    # Store agrees.
+    assert StackStore(str(tmp_path)).load("demo").ready
+
+
+def test_create_stack_duplicate_rejected(tmp_path):
+    cfg = _mk_cfg(tmp_path)
+    create_stack(cfg, provisioner=DryRunProvisioner(), _sleep=lambda s: None)
+    with pytest.raises(ProvisionError, match="already exists"):
+        create_stack(cfg, provisioner=DryRunProvisioner(),
+                     _sleep=lambda s: None)
+
+
+def test_create_stack_partial_failure(tmp_path):
+    """A host that never becomes healthy fails the stack — the
+    WaitCondition-timeout contract: no partial cluster is ever handed out."""
+    cfg = _mk_cfg(tmp_path)
+    with pytest.raises(ProvisionError, match="failed to assemble"):
+        create_stack(cfg, provisioner=DryRunProvisioner(fail_hosts=[1]),
+                     _sleep=lambda s: None)
+    assert StackStore(str(tmp_path)).load("demo").status == \
+        StackStatus.CREATE_FAILED
+
+
+def test_create_stack_timeout(tmp_path):
+    cfg = _mk_cfg(tmp_path, create_timeout_s=0)
+    with pytest.raises(ProvisionError, match="timed out"):
+        create_stack(cfg, provisioner=DryRunProvisioner(ready_after_polls=99),
+                     _sleep=lambda s: None)
+
+
+def test_delete_stack(tmp_path):
+    cfg = _mk_cfg(tmp_path)
+    state = create_stack(cfg, provisioner=DryRunProvisioner(),
+                         _sleep=lambda s: None)
+    hostfile = state.hostfile
+    assert os.path.exists(hostfile)
+    delete_stack("demo", store=StackStore(str(tmp_path)))
+    assert not os.path.exists(hostfile)
+    assert StackStore(str(tmp_path)).load_or_none("demo") is None
+
+
+# -- runtime contract -------------------------------------------------------
+
+
+def test_hostfile_roundtrip(tmp_path):
+    path = str(tmp_path / "hosts")
+    rt.write_hostfile(path, ["10.0.0.1", "10.0.0.2"])
+    assert rt.read_hostfile(path) == ["10.0.0.1", "10.0.0.2"]
+
+
+def test_cluster_env_and_back(tmp_path):
+    hostfile = rt.write_hostfile(str(tmp_path / "hosts"),
+                                 ["10.0.0.1", "10.0.0.2", "10.0.0.3"])
+    spec = rt.ClusterSpec(hosts=["10.0.0.1", "10.0.0.2", "10.0.0.3"],
+                          chips_per_host=4, hostfile=hostfile)
+    env = rt.cluster_env(spec, process_id=2)
+    assert env[rt.ENV_WORKERS_COUNT] == "3"
+    assert env[rt.ENV_COORDINATOR] == "10.0.0.1:8476"
+    assert env[rt.ENV_PROCESS_ID] == "2"
+    # A worker process reconstructs the same spec from its environment.
+    spec2 = rt.current_cluster(env)
+    assert spec2 is not None
+    assert spec2.hosts == spec.hosts
+    assert spec2.process_id == 2
+    assert spec2.coordinator == "10.0.0.1:8476"
+    assert spec2.is_multi_host
+
+
+def test_current_cluster_absent_contract():
+    assert rt.current_cluster({}) is None
+
+
+def test_initialize_single_host_noop():
+    spec = rt.initialize(rt.ClusterSpec(hosts=["localhost"]))
+    assert not spec.is_multi_host
+
+
+def test_cluster_spec_validation():
+    with pytest.raises(ValueError):
+        rt.ClusterSpec(hosts=[]).validate()
+    with pytest.raises(ValueError):
+        rt.ClusterSpec(hosts=["a"], process_id=1).validate()
+
+
+# -- real multi-process rendezvous -----------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_rendezvous(tmp_path):
+    """Two real OS processes join through the env contract and see each
+    other's devices — jax.distributed over the launcher's env block, the
+    rebuild's MPI-rendezvous replacement, minus TPUs."""
+    port = _free_port()
+    spec = rt.ClusterSpec(hosts=["127.0.0.1", "127.0.0.1"],
+                          coordinator_port=port)
+    script = textwrap.dedent("""
+        import jax
+        # The image's sitecustomize pre-registers a TPU plugin; env var alone
+        # is too late (same workaround as tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+        from deeplearning_cfn_tpu.runtime import initialize
+        spec = initialize(timeout_s=60)
+        assert spec.is_multi_host, spec
+        assert jax.process_count() == 2, jax.process_count()
+        total = jax.device_count()
+        local = jax.local_device_count()
+        assert total == 2 * local, (total, local)
+        print("RENDEZVOUS_OK", jax.process_index(), total)
+    """)
+    env_base = {k: v for k, v in os.environ.items()}
+    env_base["JAX_PLATFORMS"] = "cpu"
+    # One fake device per process keeps startup fast.
+    env_base["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    procs = []
+    for pid in range(2):
+        env = {**env_base, **rt.cluster_env(spec, pid)}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ))
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out}"
+        assert "RENDEZVOUS_OK" in out

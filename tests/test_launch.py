@@ -1,0 +1,110 @@
+"""Tests for L2: multi-host fan-out, log aggregation, failure detection and
+auto-restart (the fault-injection tier SURVEY.md §6 specifies — the reference
+had no equivalent: its Horovod jobs hung on node loss)."""
+
+import os
+import sys
+
+from deeplearning_cfn_tpu.launch import JobLauncher, LocalTransport
+from deeplearning_cfn_tpu.runtime.cluster import (
+    ClusterSpec,
+    ENV_PROCESS_ID,
+    ENV_WORKERS_COUNT,
+)
+
+
+def _spec(n):
+    return ClusterSpec(hosts=["127.0.0.1"] * n)
+
+
+def _py(code: str):
+    return [sys.executable, "-c", code]
+
+
+def test_fanout_per_rank_env(tmp_path):
+    """Every host gets the same argv but its own rank env (the reference's
+    mpirun -np N semantics)."""
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    code = (
+        "import os; open(os.path.join(r'%s', "
+        f"os.environ['{ENV_PROCESS_ID}']), 'w')"
+        f".write(os.environ['{ENV_WORKERS_COUNT}'])" % out_dir
+    )
+    launcher = JobLauncher(transport=LocalTransport(), tail_rank0=False)
+    result = launcher.run(_spec(3), _py(code), str(tmp_path / "logs"))
+    assert result.success
+    assert result.restarts == 0
+    assert sorted(os.listdir(out_dir)) == ["0", "1", "2"]
+    for i in range(3):
+        assert (out_dir / str(i)).read_text() == "3"
+
+
+def test_per_host_logs_aggregated(tmp_path):
+    code = "import os; print('hello from rank', os.environ['%s'])" % \
+        ENV_PROCESS_ID
+    launcher = JobLauncher(transport=LocalTransport(), tail_rank0=False)
+    result = launcher.run(_spec(2), _py(code), str(tmp_path / "logs"))
+    assert result.success
+    logs = sorted(os.listdir(result.log_dir))
+    assert logs == ["attempt0-host0.log", "attempt0-host1.log"]
+    text0 = (tmp_path / "logs" / logs[0]).read_text()
+    assert "hello from rank 0" in text0
+
+
+def test_failure_kills_survivors_fast(tmp_path):
+    """One host dies → the launcher kills the rest instead of letting them
+    hang in collectives (the reference's failure mode)."""
+    # Rank 1 exits 1 immediately; rank 0 would sleep for an hour.
+    code = (
+        "import os, sys, time\n"
+        f"rank = int(os.environ['{ENV_PROCESS_ID}'])\n"
+        "sys.exit(1) if rank == 1 else time.sleep(3600)\n"
+    )
+    launcher = JobLauncher(transport=LocalTransport(), max_restarts=0,
+                           tail_rank0=False)
+    import time
+    t0 = time.time()
+    result = launcher.run(_spec(2), _py(code), str(tmp_path / "logs"))
+    assert not result.success
+    assert time.time() - t0 < 30  # did not wait for the sleeper
+    assert result.exit_codes[1] == 1
+
+
+def test_fault_injection_restart_resumes(tmp_path):
+    """Kill-a-host fault injection: rank 1 crashes on the first attempt;
+    the launcher restarts the whole job and the second attempt 'resumes'
+    (observes prior attempt's marker) and succeeds."""
+    marker = tmp_path / "attempt0_happened"
+    code = (
+        "import os, sys\n"
+        f"rank = int(os.environ['{ENV_PROCESS_ID}'])\n"
+        f"marker = r'{marker}'\n"
+        "if not os.path.exists(marker):\n"
+        "    open(marker, 'w').write('x')\n"
+        "    sys.exit(7) if rank == 1 else sys.exit(0)\n"
+        "print('RESUMED rank', rank)\n"
+    )
+    failures = []
+    launcher = JobLauncher(transport=LocalTransport(), max_restarts=2,
+                           tail_rank0=False)
+    result = launcher.run(
+        _spec(2), _py(code), str(tmp_path / "logs"),
+        on_failure=lambda idx, host: failures.append(idx),
+    )
+    assert result.success
+    assert result.restarts == 1
+    assert failures == [1]
+    # Attempt-1 logs show the resumed run.
+    log = (tmp_path / "logs" / "attempt1-host1.log").read_text()
+    assert "RESUMED rank 1" in log
+
+
+def test_restart_budget_exhausted(tmp_path):
+    launcher = JobLauncher(transport=LocalTransport(), max_restarts=1,
+                           tail_rank0=False)
+    result = launcher.run(_spec(2), _py("import sys; sys.exit(3)"),
+                          str(tmp_path / "logs"))
+    assert not result.success
+    assert result.restarts == 1
+    assert set(result.exit_codes) == {3}
