@@ -1,1 +1,127 @@
-"""Placeholder — implemented in a later milestone this round."""
+"""BERT for MLM+NSP pretraining.
+
+Replaces the reference's TF+Horovod BERT-base Wikipedia pretraining scripts
+(SURVEY.md §3.1 "TF+Horovod BERT"): MLM + next-sentence-prediction heads,
+gather-at-masked-positions with a static max_predictions_per_seq (TPU static
+shapes — the TF scripts did the same for TPU compatibility), tied MLM output
+embedding. Encoder is the shared TransformerLayer stack in post-LN (original
+BERT) layout; attention runs through the fused/flash kernel.
+
+Batch contract (see data/text.py): input_ids, input_mask, segment_ids
+[B, S]; mlm_positions, mlm_ids, mlm_weights [B, P]; nsp_label [B].
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from . import register_model
+from .transformer import (
+    Embed,
+    TRANSFORMER_PARAM_RULES,
+    TransformerLayer,
+    padding_bias,
+)
+
+PARAM_RULES = TRANSFORMER_PARAM_RULES
+
+
+class BertEncoder(nn.Module):
+    vocab_size: int
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16
+    dropout_rate: float = 0.0
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, input_ids, input_mask, segment_ids,
+                 deterministic=True):
+        x, token_emb = Embed(
+            self.vocab_size, self.hidden_size, self.max_len,
+            num_segments=2, dtype=self.dtype,
+            dropout_rate=self.dropout_rate, name="embed",
+        )(input_ids, segment_ids, deterministic=deterministic)
+        bias = padding_bias(input_mask)
+        for i in range(self.num_layers):
+            x = TransformerLayer(
+                self.num_heads, self.mlp_dim, self.dtype,
+                self.dropout_rate, prenorm=False,
+                attention_impl=self.attention_impl, name=f"layer_{i}",
+            )(x, self_bias=bias, deterministic=deterministic)
+        return x, token_emb
+
+
+class BertPretrain(nn.Module):
+    """Encoder + MLM head (tied decoder) + NSP head."""
+
+    vocab_size: int
+    num_classes: int = 2  # NSP
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16
+    dropout_rate: float = 0.0
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, input_ids, input_mask, segment_ids, mlm_positions,
+                 train: bool = True):
+        x, token_emb = BertEncoder(
+            self.vocab_size, self.hidden_size, self.num_layers,
+            self.num_heads, self.mlp_dim, self.max_len, self.dtype,
+            self.dropout_rate, self.attention_impl, name="encoder",
+        )(input_ids, input_mask, segment_ids, deterministic=not train)
+
+        # MLM head on the masked positions only ([B,P] gather — static P).
+        gathered = jnp.take_along_axis(
+            x, mlm_positions[:, :, None].astype(jnp.int32), axis=1)
+        h = nn.Dense(self.hidden_size, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="mlm_transform")(gathered)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="mlm_norm")(h)
+        # Tied output embedding (BERT's weight sharing) + output bias.
+        mlm_logits = token_emb.attend(h.astype(jnp.float32))
+        mlm_bias = self.param("mlm_bias", nn.initializers.zeros_init(),
+                              (self.vocab_size,), jnp.float32)
+        mlm_logits = mlm_logits + mlm_bias
+
+        # NSP head on the [CLS] (position 0) vector, tanh pooler as in BERT.
+        pooled = nn.tanh(nn.Dense(
+            self.hidden_size, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="pooler")(x[:, 0, :].astype(jnp.float32)))
+        nsp_logits = nn.Dense(self.num_classes, dtype=jnp.float32,
+                              name="nsp_head")(pooled)
+        return {"mlm_logits": mlm_logits, "nsp_logits": nsp_logits}
+
+
+@register_model("bert_base")
+def bert_base(num_classes: int = 2, dtype=jnp.bfloat16, *,
+              vocab_size: int = 30522, hidden_size: int = 768,
+              num_layers: int = 12, num_heads: int = 12,
+              mlp_dim: int = 3072, max_len: int = 512,
+              dropout_rate: float = 0.0, attention_impl: str = "auto"):
+    return BertPretrain(
+        vocab_size=vocab_size, num_classes=num_classes,
+        hidden_size=hidden_size, num_layers=num_layers,
+        num_heads=num_heads, mlp_dim=mlp_dim, max_len=max_len,
+        dtype=dtype, dropout_rate=dropout_rate,
+        attention_impl=attention_impl)
+
+
+@register_model("bert_tiny")
+def bert_tiny(num_classes: int = 2, dtype=jnp.float32, **kw):
+    """Test-scale config (2 layers, 128 hidden) for CPU smoke/convergence."""
+    defaults = dict(vocab_size=512, hidden_size=128, num_layers=2,
+                    num_heads=4, mlp_dim=256, max_len=128)
+    defaults.update(kw)
+    return BertPretrain(num_classes=num_classes, dtype=dtype, **defaults)

@@ -1,0 +1,187 @@
+"""Shared transformer building blocks (BERT encoder, NMT encoder-decoder).
+
+Replaces the attention/FFN layers inside the reference's TF BERT scripts and
+Sockeye's MXNet transformer (SURVEY.md §3.1) with one Flax implementation.
+
+TPU-first choices:
+- attention goes through ``ops.fused_attention`` (Pallas flash kernel on
+  TPU; jnp reference elsewhere) — no [S,S] score tensor in HBM;
+- bfloat16 activations, float32 params and LayerNorm statistics;
+- hidden/mlp dims are multiples of 128 in the shipped presets (MXU tiling);
+- tensor-parallel readiness: QKV/MLP kernels carry ``param_rules`` entries
+  sharding their output dim over the mesh 'model' axis (pjit inserts the
+  collectives when the axis is >1; with model=1 they replicate — pure DP).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops import fused_attention
+
+Dtype = Any
+
+# Param-path rules for the 'model' mesh axis (see sharding.param_sharding_tree):
+# attention/MLP input projections shard their output features; output
+# projections shard their input features — the Megatron column/row split.
+TRANSFORMER_PARAM_RULES = (
+    (r"(query|key|value)/kernel", P(None, "model")),
+    (r"attn_out/kernel", P("model", None)),
+    (r"mlp_in/kernel", P(None, "model")),
+    (r"mlp_out/kernel", P("model", None)),
+)
+
+
+class MultiHeadAttention(nn.Module):
+    """Self- or cross-attention over [B, S, H*D] activations."""
+
+    num_heads: int
+    dtype: Dtype = jnp.bfloat16
+    dropout_rate: float = 0.0
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, kv=None, bias=None, causal=False,
+                 deterministic=True):
+        kv = x if kv is None else kv
+        features = x.shape[-1]
+        if features % self.num_heads:
+            raise ValueError(
+                f"hidden size {features} not divisible by "
+                f"{self.num_heads} heads")
+        head_dim = features // self.num_heads
+        dense = lambda name: nn.Dense(
+            features, dtype=self.dtype, param_dtype=jnp.float32, name=name,
+            kernel_init=nn.initializers.xavier_uniform())
+
+        def split(t):  # [B,S,F] -> [B,H,S,D]
+            b, s, _ = t.shape
+            return t.reshape(b, s, self.num_heads, head_dim) \
+                .transpose(0, 2, 1, 3)
+
+        q = split(dense("query")(x))
+        k = split(dense("key")(kv))
+        v = split(dense("value")(kv))
+        out = fused_attention(q, k, v, bias=bias, causal=causal,
+                              implementation=self.attention_impl)
+        b, h, s, d = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        out = dense("attn_out")(out)
+        if self.dropout_rate > 0:
+            out = nn.Dropout(self.dropout_rate)(
+                out, deterministic=deterministic)
+        return out
+
+
+class Mlp(nn.Module):
+    mlp_dim: int
+    dtype: Dtype = jnp.bfloat16
+    dropout_rate: float = 0.0
+    act: Callable = nn.gelu
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        features = x.shape[-1]
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="mlp_in",
+                     kernel_init=nn.initializers.xavier_uniform())(x)
+        y = self.act(y)
+        y = nn.Dense(features, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="mlp_out",
+                     kernel_init=nn.initializers.xavier_uniform())(y)
+        if self.dropout_rate > 0:
+            y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
+        return y
+
+
+class TransformerLayer(nn.Module):
+    """One block: self-attn (+ optional cross-attn) + FFN.
+
+    ``prenorm=False`` is the BERT/original-transformer post-LN layout;
+    ``prenorm=True`` the more stable pre-LN used by the NMT preset.
+    """
+
+    num_heads: int
+    mlp_dim: int
+    dtype: Dtype = jnp.bfloat16
+    dropout_rate: float = 0.0
+    prenorm: bool = False
+    cross_attention: bool = False
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, enc=None, self_bias=None, cross_bias=None,
+                 causal=False, deterministic=True):
+        ln = lambda name: nn.LayerNorm(
+            dtype=self.dtype, param_dtype=jnp.float32, name=name)
+        attn = lambda name: MultiHeadAttention(
+            self.num_heads, self.dtype, self.dropout_rate,
+            self.attention_impl, name=name)
+
+        def residual(x, sub, name):
+            if self.prenorm:
+                return x + sub(ln(f"{name}_norm")(x))
+            return ln(f"{name}_norm")(x + sub(x))
+
+        x = residual(
+            x, lambda y: attn("self_attn")(
+                y, bias=self_bias, causal=causal,
+                deterministic=deterministic),
+            "self_attn")
+        if self.cross_attention:
+            if enc is None:
+                raise ValueError("cross_attention layer needs encoder output")
+            x = residual(
+                x, lambda y: attn("cross_attn")(
+                    y, kv=enc, bias=cross_bias,
+                    deterministic=deterministic),
+                "cross_attn")
+        x = residual(
+            x, lambda y: Mlp(self.mlp_dim, self.dtype, self.dropout_rate,
+                             name="mlp")(y, deterministic=deterministic),
+            "mlp")
+        return x
+
+
+class Embed(nn.Module):
+    """Token + learned-position (+ optional segment) embeddings."""
+
+    vocab_size: int
+    hidden_size: int
+    max_len: int
+    num_segments: int = 0
+    dtype: Dtype = jnp.bfloat16
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, ids, segment_ids=None, deterministic=True):
+        emb = nn.Embed(self.vocab_size, self.hidden_size,
+                       param_dtype=jnp.float32,
+                       embedding_init=nn.initializers.normal(0.02),
+                       name="token")
+        x = emb(ids)
+        pos = self.param(
+            "position", nn.initializers.normal(0.02),
+            (self.max_len, self.hidden_size), jnp.float32)
+        x = x + pos[None, :ids.shape[1], :]
+        if self.num_segments and segment_ids is not None:
+            seg = nn.Embed(self.num_segments, self.hidden_size,
+                           param_dtype=jnp.float32,
+                           embedding_init=nn.initializers.normal(0.02),
+                           name="segment")
+            x = x + seg(segment_ids)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="norm")(x.astype(self.dtype))
+        if self.dropout_rate > 0:
+            x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
+        return x, emb
+
+
+def padding_bias(mask: jnp.ndarray) -> jnp.ndarray:
+    """[B, S] 1/0 attention mask → additive bias [B, 1, 1, S]."""
+    return jnp.where(mask.astype(bool), 0.0, -1e30)[:, None, None, :] \
+        .astype(jnp.float32)

@@ -1,1 +1,106 @@
-"""Placeholder — implemented in a later milestone this round."""
+"""Transformer NMT (encoder-decoder) — the Sockeye workload, rebuilt.
+
+Replaces the reference's Sockeye MXNet Transformer trained with
+``--kvstore dist_device_sync`` on WMT En-De (SURVEY.md §3.1 "Sockeye NMT").
+Vanilla transformer-base architecture: 6+6 layers, shared source/target
+embedding tied with the output projection (Sockeye's weight-tying default),
+pre-LN blocks (stable without Sockeye's custom init), causal decoder
+self-attention and encoder-decoder cross-attention through the fused/flash
+kernel.
+
+Batch contract (see data/text.py): src_ids [B, S], src_mask [B, S],
+tgt_in_ids [B, T] (BOS-shifted), tgt_out_ids [B, T], tgt_mask [B, T].
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from . import register_model
+from .transformer import (
+    Embed,
+    TRANSFORMER_PARAM_RULES,
+    TransformerLayer,
+    padding_bias,
+)
+
+PARAM_RULES = TRANSFORMER_PARAM_RULES
+
+
+class TransformerNMT(nn.Module):
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    num_layers: int = 6
+    num_heads: int = 8
+    mlp_dim: int = 2048
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16
+    dropout_rate: float = 0.0
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, src_ids, src_mask, tgt_in_ids, train: bool = True):
+        det = not train
+        # Shared source/target embedding (Sockeye ties all three matrices).
+        x, token_emb = Embed(
+            self.vocab_size, self.hidden_size, self.max_len,
+            dtype=self.dtype, dropout_rate=self.dropout_rate, name="embed",
+        )(src_ids, deterministic=det)
+        enc_bias = padding_bias(src_mask)
+        for i in range(self.num_layers):
+            x = TransformerLayer(
+                self.num_heads, self.mlp_dim, self.dtype, self.dropout_rate,
+                prenorm=True, attention_impl=self.attention_impl,
+                name=f"enc_{i}",
+            )(x, self_bias=enc_bias, deterministic=det)
+        enc = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                           name="enc_norm")(x)
+
+        # Decoder reuses the tied embedding table for target tokens.
+        y = token_emb(tgt_in_ids)
+        y = y + self.param(
+            "tgt_position", nn.initializers.normal(0.02),
+            (self.max_len, self.hidden_size), jnp.float32,
+        )[None, :tgt_in_ids.shape[1], :]
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="tgt_embed_norm")(y.astype(self.dtype))
+        for i in range(self.num_layers):
+            y = TransformerLayer(
+                self.num_heads, self.mlp_dim, self.dtype, self.dropout_rate,
+                prenorm=True, cross_attention=True,
+                attention_impl=self.attention_impl, name=f"dec_{i}",
+            )(y, enc=enc, cross_bias=enc_bias, causal=True,
+              deterministic=det)
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="dec_norm")(y)
+
+        # Tied output projection: logits = y · Eᵀ.
+        logits = token_emb.attend(y.astype(jnp.float32))
+        return logits
+
+
+@register_model("transformer_nmt")
+def transformer_nmt(num_classes: int = 0, dtype=jnp.bfloat16, *,
+                    vocab_size: int = 32000, hidden_size: int = 512,
+                    num_layers: int = 6, num_heads: int = 8,
+                    mlp_dim: int = 2048, max_len: int = 512,
+                    dropout_rate: float = 0.0, attention_impl: str = "auto"):
+    del num_classes  # vocab_size plays that role
+    return TransformerNMT(
+        vocab_size=vocab_size, hidden_size=hidden_size,
+        num_layers=num_layers, num_heads=num_heads, mlp_dim=mlp_dim,
+        max_len=max_len, dtype=dtype, dropout_rate=dropout_rate,
+        attention_impl=attention_impl)
+
+
+@register_model("transformer_nmt_tiny")
+def transformer_nmt_tiny(num_classes: int = 0, dtype=jnp.float32, **kw):
+    """Test-scale config for CPU smoke/convergence."""
+    del num_classes
+    defaults = dict(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, mlp_dim=128, max_len=64)
+    defaults.update(kw)
+    return TransformerNMT(dtype=dtype, **defaults)

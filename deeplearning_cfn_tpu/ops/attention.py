@@ -1,0 +1,291 @@
+"""Fused multi-head attention: Pallas flash kernel + jnp reference.
+
+Replaces the reference workloads' cuDNN/fused-CUDA attention (BERT, NMT —
+SURVEY.md §3.3 "cuDNN / framework kernels"). Design:
+
+- ``attention_reference``: straight jnp softmax(QKᵀ/√d + bias)V — the
+  numerics oracle and the CPU/GPU fallback. XLA fuses this well already;
+  the flash kernel's win is avoiding the [S,S] materialization in HBM.
+- ``_flash_forward``: Pallas TPU kernel, online-softmax blocked over the KV
+  sequence (flash attention). Grid is (batch, heads, Q blocks); K/V live in
+  VMEM whole (fine to ~16k tokens at d=64; long-context beyond that is the
+  ring-attention path in ring_attention.py).
+- ``fused_attention``: public entry — dispatches to the kernel on TPU,
+  reference elsewhere; custom VJP recomputes the backward through the
+  reference implementation (flash-style recompute: nothing but the output
+  is saved, trading FLOPs for HBM exactly like jax.checkpoint).
+
+Shapes: q [B, H, Sq, D]; k/v [B, H, Sk, D]; optional additive bias
+broadcastable to [B, H, Sq, Sk] (use -inf for padding); returns [B, H, Sq, D].
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax NaN-free
+
+# Flash kernel tiling. 128 matches the MXU/VPU lane width; q blocks of 256
+# amortize the loop while staying well inside VMEM.
+_BLOCK_Q = 256
+_BLOCK_K = 128
+
+
+# ---------------------------------------------------------------------------
+# Reference implementation (oracle + fallback + backward)
+# ---------------------------------------------------------------------------
+
+
+def attention_reference(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: Optional[jnp.ndarray] = None,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Plain jnp attention; computes in f32 regardless of input dtype (the
+    softmax accumulator precision the kernel also uses)."""
+    *_, sq, d = q.shape
+    sk = k.shape[-2]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        q_pos = jnp.arange(sq)[:, None] + (sk - sq)  # align ends
+        k_pos = jnp.arange(sk)[None, :]
+        logits = jnp.where(k_pos <= q_pos, logits, _NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash kernel (forward)
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, causal: bool,
+                  sm_scale: float, block_k: int, seq_k: int, seq_q: int):
+    """One (batch, head, q-block) program: online softmax over KV blocks.
+
+    ``seq_q``/``seq_k`` are the TRUE (unpadded) lengths — the causal
+    diagonal aligns their ends; the refs hold the block-padded arrays.
+    Refs arrive with the leading (1, 1) batch/head block dims squeezed via
+    indexing; accumulation is f32 in VMEM registers (m, l, acc carried
+    through the fori_loop), written once at the end — the [S,S] score matrix
+    never exists in HBM.
+    """
+    from jax.experimental import pallas as pl  # deferred: TPU-only path
+
+    block_q = q_ref.shape[-2]
+    d = q_ref.shape[-1]
+    iq = pl.program_id(2)
+
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * sm_scale
+
+    num_kb = k_ref.shape[-2] // block_k  # padded block count
+    if causal:
+        # Skip KV blocks entirely above the diagonal for this q block
+        # (true positions: padded k columns lie above it by construction).
+        q_end = (iq + 1) * block_q + (seq_k - seq_q)
+        num_kb_live = jnp.minimum((q_end + block_k - 1) // block_k, num_kb)
+    else:
+        num_kb_live = num_kb
+
+    def body(kb, carry):
+        m_prev, l_prev, acc_prev = carry
+        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        if bias_ref is not None:
+            s = s + bias_ref[0, 0, :, pl.ds(kb * block_k, block_k)] \
+                .astype(jnp.float32)
+        if causal:
+            q_pos = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + iq * block_q \
+                + (seq_k - seq_q)
+            k_pos = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1) + kb * block_k
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [block_q, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc_prev * alpha + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    init = (
+        jnp.full((block_q, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((block_q, 1), jnp.float32),
+        jnp.zeros((block_q, d), jnp.float32),
+    )
+    m, l, acc = jax.lax.fori_loop(0, num_kb_live, body, init)
+    # Fully-masked rows (all -inf) have l == 0; emit zeros, not NaNs.
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
+
+
+def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_forward(q, k, v, bias, causal, sm_scale, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[-2]
+    block_q = min(_BLOCK_Q, max(8, sq))
+    block_k = min(_BLOCK_K, max(8, sk))
+
+    qp = _pad_to(q, 2, block_q)
+    kp = _pad_to(k, 2, block_k)
+    vp = _pad_to(v, 2, block_k)
+    sq_p, sk_p = qp.shape[2], kp.shape[2]
+    if bias is not None:
+        # Align the user bias's K axis with the padded KV (zeros are fine:
+        # the pad_bias below kills padded columns).
+        if bias.shape[-1] not in (sk, sk_p):
+            raise ValueError(
+                f"bias K dim {bias.shape[-1]} incompatible with kv length "
+                f"{sk}")
+        bias = _pad_to(bias.astype(jnp.float32), 3, block_k) \
+            if bias.shape[-1] == sk else bias.astype(jnp.float32)
+    if sk_p != sk and not causal:
+        # Padded KV columns must never win the softmax. (The causal mask
+        # already excludes them: q_pos < sk for every real row.)
+        pad_bias = jnp.where(
+            jnp.arange(sk_p) < sk, 0.0, _NEG_INF)[None, None, None, :]
+        bias = pad_bias if bias is None else bias + pad_bias
+
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
+        pl.BlockSpec((1, 1, sk_p, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
+        pl.BlockSpec((1, 1, sk_p, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
+    ]
+    args = [qp, kp, vp]
+    # The causal diagonal is defined by the TRUE lengths (ends aligned, as
+    # in attention_reference); padded q rows are sliced off at the end and
+    # padded k columns sit above the diagonal, so neither corrupts it.
+    kernel_kw = dict(causal=causal, sm_scale=sm_scale, block_k=block_k,
+                     seq_k=sk, seq_q=sq)
+    if bias is not None:
+        # Keep broadcast dims at size 1 (indexed with block 0) instead of
+        # materializing [B,H,Sq,Sk] in HBM.
+        bb, bh, bq = bias.shape[0], bias.shape[1], bias.shape[2]
+        if bq > 1:
+            bias = _pad_to(bias, 2, block_q)
+        block_bq = block_q if bq > 1 else 1
+        in_specs.append(pl.BlockSpec(
+            (1, 1, block_bq, sk_p),
+            lambda ib, ih, iq: (ib if bb > 1 else 0, ih if bh > 1 else 0,
+                                iq if bq > 1 else 0, 0)))
+        args.append(bias)
+        kernel = functools.partial(_flash_kernel, **kernel_kw)
+    else:
+        def kernel(q_ref, k_ref, v_ref, o_ref):
+            _flash_kernel(q_ref, k_ref, v_ref, None, o_ref, **kernel_kw)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h, sq_p // block_q),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda ib, ih, iq: (ib, ih, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ) if not interpret else None,
+        interpret=interpret,
+    )(*args)
+    return out[:, :, :sq, :]
+
+
+# ---------------------------------------------------------------------------
+# Public entry with custom VJP
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _fused_attention(q, k, v, bias, causal, sm_scale, use_pallas, interpret):
+    if use_pallas:
+        return _flash_forward(q, k, v, bias, causal, sm_scale,
+                              interpret=interpret)
+    return attention_reference(q, k, v, bias, causal, sm_scale)
+
+
+def _fwd(q, k, v, bias, causal, sm_scale, use_pallas, interpret):
+    out = _fused_attention(q, k, v, bias, causal, sm_scale, use_pallas,
+                           interpret)
+    return out, (q, k, v, bias)
+
+
+def _bwd(causal, sm_scale, use_pallas, interpret, res, g):
+    # Flash-style backward: recompute attention (reference formulation —
+    # XLA fuses it) instead of saving softmax weights. Costs one extra
+    # forward of FLOPs, saves the [B,H,S,S] residual in HBM.
+    q, k, v, bias = res
+    def f(q, k, v, bias):
+        return attention_reference(q, k, v, bias, causal, sm_scale)
+    _, vjp = jax.vjp(f, q, k, v, bias)
+    dq, dk, dv, dbias = vjp(g)
+    return dq, dk, dv, None if bias is None else dbias
+
+
+_fused_attention.defvjp(_fwd, _bwd)
+
+
+def fused_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: Optional[jnp.ndarray] = None,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    implementation: str = "auto",
+) -> jnp.ndarray:
+    """Multi-head attention, fused on TPU.
+
+    implementation: 'auto' (pallas on TPU backend, reference otherwise),
+    'pallas', 'reference', or 'interpret' (pallas kernel in interpreter
+    mode — CPU-runnable, used by tests to validate kernel numerics).
+    """
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        raise ValueError(f"expected [B,H,S,D] inputs, got {q.shape}")
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if implementation == "auto":
+        use_pallas = jax.default_backend() == "tpu"
+        interpret = False
+    elif implementation == "pallas":
+        use_pallas, interpret = True, False
+    elif implementation == "interpret":
+        use_pallas, interpret = True, True
+    elif implementation == "reference":
+        use_pallas, interpret = False, False
+    else:
+        raise ValueError(f"unknown implementation {implementation!r}")
+    return _fused_attention(q, k, v, bias, causal, scale, use_pallas,
+                            interpret)

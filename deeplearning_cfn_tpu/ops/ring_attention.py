@@ -1,0 +1,120 @@
+"""Ring attention: sequence-parallel exact attention over a mesh axis.
+
+Long-context support (task contract; absent from the reference, whose max
+sequence was BERT's 512 — SURVEY.md §6). Sequences longer than one chip's
+HBM shard across a mesh axis; each device holds a [S/N] slice of Q, K, V.
+K/V blocks then rotate around the ring via ``lax.ppermute`` (XLA lowers it
+to ICI neighbor transfers), and every device accumulates its Q block's
+attention with the same online-softmax update the flash kernel uses — so
+the result is *exact* attention, with compute and communication overlapped
+by XLA's collective scheduler, not an approximation.
+
+``ring_attention`` is the per-shard collective function (call inside
+``shard_map``); ``ring_attention_sharded`` wraps it for a global array +
+mesh. Causality is handled with global positions derived from the axis
+index, so block (i, j) is skipped entirely when it lies above the diagonal.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, bias_blk, q_off, k_off, causal, scale):
+    """One (local Q, rotating KV) block: returns (m, l-scaled) partials."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias_blk is not None:
+        s = s + bias_blk.astype(jnp.float32)
+    if causal:
+        sq, sk = q.shape[-2], k.shape[-2]
+        q_pos = jnp.arange(sq)[:, None] + q_off
+        k_pos = jnp.arange(sk)[None, :] + k_off
+        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # [b,h,q,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    return m, l, pv
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Per-shard ring attention (use inside shard_map).
+
+    q/k/v: this device's sequence shard, [B, H, S_local, D]; the global
+    sequence is the concatenation over ``axis_name`` in axis-index order.
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    q_off = my_idx * s_local
+
+    def step(carry, r):
+        m_prev, l_prev, acc_prev, kv = carry
+        k_r, v_r = kv
+        # After r rotations we hold the shard originally on (my_idx - r).
+        src = (my_idx - r) % axis_size
+        k_off = src * s_local
+        m_cur, l_cur, pv = _block_attn(q, k_r, v_r, None, q_off, k_off,
+                                       causal, scale)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha_prev = jnp.exp(m_prev - m_new)
+        alpha_cur = jnp.exp(m_cur - m_new)
+        l_new = l_prev * alpha_prev + l_cur * alpha_cur
+        acc_new = acc_prev * alpha_prev + pv * alpha_cur
+        # Rotate KV to the next device; XLA overlaps this ppermute with the
+        # next iteration's einsums where the schedule allows.
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_next = jax.lax.ppermute(k_r, axis_name, perm)
+        v_next = jax.lax.ppermute(v_r, axis_name, perm)
+        return (m_new, l_new, acc_new, (k_next, v_next)), None
+
+    init = (
+        jnp.full((b, h, s_local, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((b, h, s_local, 1), jnp.float32),
+        jnp.zeros((b, h, s_local, d), jnp.float32),
+        (k, v),
+    )
+    (m, l, acc, _), _ = jax.lax.scan(step, init, jnp.arange(axis_size))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str = "data",
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Global-array wrapper: shards the sequence dim over ``axis_name`` and
+    runs the ring. Batch/head/feature dims stay replicated here — compose
+    with data-parallel sharding by calling ``ring_attention`` directly
+    inside your own shard_map with richer PartitionSpecs."""
+    spec = P(None, None, axis_name, None)
+    fn = partial(ring_attention, axis_name=axis_name, causal=causal,
+                 sm_scale=sm_scale)
+    mapped = jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return mapped(q, k, v)

@@ -1,0 +1,17 @@
+"""Detection task (Mask R-CNN) — lands with the detection milestone.
+
+Kept as a clear error (not a broken import) so build_task's dispatch for
+``maskrcnn*`` model names fails with guidance until the model ships.
+"""
+
+from __future__ import annotations
+
+from ..config import ExperimentConfig
+
+
+class DetectionTask:
+    def __init__(self, cfg: ExperimentConfig):
+        raise NotImplementedError(
+            "maskrcnn task lands in the detection milestone this round; "
+            "resnet/bert/transformer_nmt workloads are live"
+        )

@@ -92,15 +92,124 @@ class ClassificationTask:
         return loss, aux
 
 
+class MlmTask:
+    """BERT MLM+NSP pretraining (reference: TF+Horovod BERT scripts).
+
+    Loss = masked-LM cross-entropy (weighted mean over real predictions) +
+    next-sentence cross-entropy — the standard BERT objective. Batch
+    contract documented in data/text.py make_mlm_source.
+    """
+
+    def __init__(self, cfg: ExperimentConfig):
+        self.cfg = cfg
+        dtype = jnp.bfloat16 if cfg.train.dtype == "bfloat16" else jnp.float32
+        kwargs = dict(cfg.model.kwargs)
+        kwargs.setdefault("vocab_size", cfg.data.vocab_size)
+        kwargs.setdefault("max_len", max(cfg.data.seq_len, 128))
+        self.model = build_model(cfg.model.name, cfg.model.num_classes,
+                                 dtype, **kwargs)
+        from ..models.bert import PARAM_RULES
+
+        self.param_rules = PARAM_RULES
+        self.remat = cfg.train.remat
+
+    def init(self, rng: jax.Array):
+        s = self.cfg.data.seq_len
+        p = max(1, int(s * 0.2))
+        ids = jnp.zeros((1, s), jnp.int32)
+        return self.model.init(rng, ids, jnp.ones((1, s), jnp.int32), ids,
+                               jnp.zeros((1, p), jnp.int32), train=False)
+
+    def loss_fn(self, params, batch_stats, batch, rng, train):
+        rngs = {"dropout": rng} if (train and rng is not None) else None
+        apply = lambda p, b: self.model.apply(
+            {"params": p}, b["input_ids"], b["input_mask"],
+            b["segment_ids"], b["mlm_positions"], train=train, rngs=rngs)
+        if train and self.remat:
+            apply = jax.checkpoint(apply)
+        out = apply(params, batch)
+        weights = batch["mlm_weights"]
+        mlm_ce = cross_entropy(out["mlm_logits"], batch["mlm_ids"])
+        # Weighted global mean — masked slots carry no gradient, and the
+        # normalizer is the global count, so DP psum stays correct.
+        mlm_loss = jnp.sum(mlm_ce * weights) / jnp.maximum(
+            jnp.sum(weights), 1e-6)
+        nsp_loss = jnp.mean(cross_entropy(out["nsp_logits"],
+                                          batch["nsp_label"]))
+        loss = mlm_loss + nsp_loss
+        mlm_hits = (jnp.argmax(out["mlm_logits"], -1) == batch["mlm_ids"])
+        aux = {
+            "mlm_loss": mlm_loss,
+            "nsp_loss": nsp_loss,
+            "mlm_accuracy": jnp.sum(mlm_hits * weights) / jnp.maximum(
+                jnp.sum(weights), 1e-6),
+            "nsp_accuracy": jnp.mean(
+                (jnp.argmax(out["nsp_logits"], -1) == batch["nsp_label"])
+                .astype(jnp.float32)),
+        }
+        if train:
+            aux["batch_stats"] = batch_stats
+        return loss, aux
+
+
+class Seq2SeqTask:
+    """Transformer NMT (reference: Sockeye MXNet, dist_device_sync).
+
+    Per-token label-smoothed cross-entropy, masked to real target positions,
+    normalized by the global token count (Sockeye's per-token loss).
+    """
+
+    def __init__(self, cfg: ExperimentConfig):
+        self.cfg = cfg
+        dtype = jnp.bfloat16 if cfg.train.dtype == "bfloat16" else jnp.float32
+        kwargs = dict(cfg.model.kwargs)
+        kwargs.setdefault("vocab_size", cfg.data.vocab_size)
+        kwargs.setdefault("max_len", max(cfg.data.seq_len, 64))
+        self.model = build_model(cfg.model.name, 0, dtype, **kwargs)
+        from ..models.transformer_nmt import PARAM_RULES
+
+        self.param_rules = PARAM_RULES
+        self.remat = cfg.train.remat
+
+    def init(self, rng: jax.Array):
+        s = self.cfg.data.seq_len
+        ids = jnp.zeros((1, s), jnp.int32)
+        return self.model.init(rng, ids, jnp.ones((1, s), jnp.int32), ids,
+                               train=False)
+
+    def loss_fn(self, params, batch_stats, batch, rng, train):
+        rngs = {"dropout": rng} if (train and rng is not None) else None
+        apply = lambda p, b: self.model.apply(
+            {"params": p}, b["src_ids"], b["src_mask"], b["tgt_in_ids"],
+            train=train, rngs=rngs)
+        if train and self.remat:
+            apply = jax.checkpoint(apply)
+        logits = apply(params, batch)
+        mask = batch["tgt_mask"]
+        ce = cross_entropy(logits, batch["tgt_out_ids"],
+                           self.cfg.train.label_smoothing)
+        denom = jnp.maximum(jnp.sum(mask), 1e-6)
+        loss = jnp.sum(ce * mask) / denom
+        hits = (jnp.argmax(logits, -1) == batch["tgt_out_ids"])
+        aux = {
+            "token_accuracy": jnp.sum(hits * mask) / denom,
+        }
+        if train:
+            aux["batch_stats"] = batch_stats
+        return loss, aux
+
+
 def build_task(cfg: ExperimentConfig):
     """Task registry keyed by model family."""
     name = cfg.model.name
     if name.startswith("resnet"):
         return ClassificationTask(cfg)
-    if name.startswith("bert") or name.startswith("transformer_nmt") or \
-            name.startswith("maskrcnn"):
-        raise NotImplementedError(
-            f"task for {name!r} lands in a later milestone this round; "
-            f"resnet workloads are live"
-        )
+    if name.startswith("bert"):
+        return MlmTask(cfg)
+    if name.startswith("transformer_nmt"):
+        return Seq2SeqTask(cfg)
+    if name.startswith("maskrcnn"):
+        from .detection_task import DetectionTask
+
+        return DetectionTask(cfg)
     raise KeyError(f"no task for model {name!r}")

@@ -1,0 +1,156 @@
+"""Numerics tests for the kernel layer (ops/): flash attention vs the jnp
+oracle (kernel run in Pallas interpreter mode — CPU-runnable), gradients
+through the custom VJP, and ring attention vs full attention on the fake
+8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning_cfn_tpu.ops import (
+    attention_reference,
+    fused_attention,
+    ring_attention_sharded,
+)
+
+
+def _qkv(b=2, h=2, sq=64, sk=64, d=32, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    mk = lambda s: jnp.asarray(rng.normal(0, 1, (b, h, s, d)), dtype)
+    return mk(sq), mk(sk), mk(d * 0 + sk)[:, :, :sk, :]
+
+
+def test_reference_matches_naive_softmax():
+    q, k, v = _qkv()
+    out = attention_reference(q, k, v)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(32.0)
+    naive = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(logits, -1), v)
+    np.testing.assert_allclose(out, naive, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk", [(64, 64), (64, 128), (100, 100)])
+def test_flash_kernel_matches_reference(causal, sq, sk):
+    """The Pallas kernel (interpreter mode) must agree with the oracle,
+    including non-block-multiple lengths (padding path) and causal masks."""
+    if causal and sq != sk and sq == 64 and sk == 128:
+        pass  # cross-length causal aligns ends — covered below too
+    q, k, v = _qkv(sq=sq, sk=sk)
+    ref = attention_reference(q, k, v, causal=causal)
+    out = fused_attention(q, k, v, causal=causal,
+                          implementation="interpret")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_kernel_with_padding_bias():
+    """Additive -inf padding bias (BERT padding mask shape [B,1,1,Sk])."""
+    q, k, v = _qkv(sq=64, sk=64)
+    kv_len = 40
+    bias = jnp.where(jnp.arange(64) < kv_len, 0.0, -1e30)
+    bias = bias[None, None, None, :]
+    ref = attention_reference(q, k, v, bias=bias)
+    out = fused_attention(q, k, v, bias=bias, implementation="interpret")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # Masked-out keys truly don't contribute.
+    v2 = v.at[:, :, kv_len:, :].set(999.0)
+    out2 = fused_attention(q, k, v2, bias=bias, implementation="interpret")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-5)
+
+
+def test_fused_attention_grads_match_reference():
+    q, k, v = _qkv(sq=32, sk=32, d=16)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    def loss_fused(q, k, v):
+        return jnp.sum(fused_attention(q, k, v, causal=True,
+                                       implementation="interpret") ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fused):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_fused_attention_bfloat16():
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    out = fused_attention(q, k, v, implementation="interpret")
+    ref = attention_reference(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=3e-2, rtol=3e-2)
+
+
+def test_fused_attention_shape_validation():
+    with pytest.raises(ValueError, match="B,H,S,D"):
+        fused_attention(jnp.zeros((4, 8, 16)), jnp.zeros((4, 8, 16)),
+                        jnp.zeros((4, 8, 16)))
+    with pytest.raises(ValueError, match="implementation"):
+        q, k, v = _qkv(sq=8, sk=8, d=8)
+        fused_attention(q, k, v, implementation="cuda")
+
+
+@pytest.mark.parametrize("sq,sk", [(192, 192), (300, 300), (40, 72)])
+def test_flash_causal_with_block_padding(sq, sk):
+    """Shapes where Q and K pad by DIFFERENT amounts: the causal diagonal
+    must still align to the true lengths (regression: padded lengths used
+    to shift the mask, leaking future positions)."""
+    q, k, v = _qkv(b=1, h=1, sq=sq, sk=sk, d=16, seed=3)
+    ref = attention_reference(q, k, v, causal=True)
+    out = fused_attention(q, k, v, causal=True, implementation="interpret")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_bias_with_kv_padding():
+    """User bias [B,1,1,sk] where sk needs block padding (regression: used
+    to crash on shape mismatch when adding the pad bias)."""
+    sk = 200
+    q, k, v = _qkv(b=1, h=2, sq=64, sk=sk, d=16, seed=4)
+    bias = jnp.where(jnp.arange(sk) < 150, 0.0, -1e30)[None, None, None, :]
+    ref = attention_reference(q, k, v, bias=bias)
+    out = fused_attention(q, k, v, bias=bias, implementation="interpret")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+# -- ring attention ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(devices, causal):
+    """Sequence sharded 8 ways over the mesh: the ring result must equal
+    single-device full attention — it is exact, not approximate."""
+    mesh = Mesh(np.asarray(devices), ("data",))
+    q, k, v = _qkv(b=2, h=2, sq=128, sk=128, d=32)
+    ref = attention_reference(q, k, v, causal=causal)
+    out = ring_attention_sharded(q, k, v, mesh, axis_name="data",
+                                 causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_grads_flow(devices):
+    mesh = Mesh(np.asarray(devices), ("data",))
+    q, k, v = _qkv(b=1, h=1, sq=64, sk=64, d=16)
+
+    def loss(q, k, v):
+        return jnp.sum(ring_attention_sharded(q, k, v, mesh,
+                                              axis_name="data") ** 2)
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(grads, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
