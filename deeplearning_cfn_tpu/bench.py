@@ -67,7 +67,8 @@ def run_bench(
     state = create_train_state(jax.random.PRNGKey(0), task.init, tx, mesh,
                                param_rules=getattr(task, "param_rules", ()))
     trainer = Trainer(cfg, task.loss_fn, tx, mesh=mesh,
-                      spatial_dim=getattr(task, "spatial_dim", None))
+                      spatial_dim=getattr(task, "spatial_dim", None),
+                      spatial_keys=getattr(task, "spatial_keys", None))
 
     pipe = build_pipeline(cfg.data, local_batch_size(gb, mesh),
                           cfg.model.num_classes, seed=0, train=True)

@@ -99,6 +99,7 @@ class DataConfig:
     image_size: int = 32
     seq_len: int = 128  # text workloads
     vocab_size: int = 30522
+    max_boxes: int = 16  # detection: GT padding size
     num_train_examples: int = 0  # 0 = dataset default
     num_eval_examples: int = 0
     shuffle_buffer: int = 50_000

@@ -126,6 +126,8 @@ class DataPipeline:
         prefetch: int = 2,
         process_index: Optional[int] = None,
         process_count: Optional[int] = None,
+        native: bool = True,
+        num_workers: int = 4,
     ):
         self.source = source
         self.local_batch = local_batch
@@ -135,6 +137,15 @@ class DataPipeline:
         self.prefetch = prefetch
         self.pidx = jax.process_index() if process_index is None else process_index
         self.pcount = jax.process_count() if process_count is None else process_count
+        self.num_workers = max(1, num_workers)
+        # Native path handles the plain and crop/flip cases; anything else
+        # (custom augment fns, sources overriding gather) stays in Python.
+        self._native = False
+        if native and (augment is None or augment is augment_crop_flip) \
+                and type(source).gather is ArraySource.gather:
+            from .. import dataio
+
+            self._native = dataio.available()
         if not drop_remainder:
             raise NotImplementedError("static shapes require drop_remainder")
 
@@ -150,6 +161,27 @@ class DataPipeline:
         per_proc = self.source.size // self.pcount
         return idx[self.pidx * per_proc:(self.pidx + 1) * per_proc]
 
+    def _gather_native(self, idx: np.ndarray, epoch: int, start: int
+                       ) -> Batch:
+        """GIL-free threaded gather (+ crop/flip) through dataio. The seed
+        mixes (pipeline seed, epoch, batch offset, process) so augmentation
+        is deterministic regardless of thread scheduling."""
+        from .. import dataio
+
+        seed = ((self.seed + 1) * 7919 + epoch * 2654435761 + start * 31 +
+                self.pidx) & (2**64 - 1)
+        out: Batch = {}
+        for k, v in self.source.arrays.items():
+            if (k == "image" and v.ndim == 4 and v.dtype == np.float32):
+                out[k] = dataio.gather_augment(
+                    v, idx, pad=4, seed=seed,
+                    augment=self.augment is augment_crop_flip,
+                    nthreads=self.num_workers)
+            else:
+                out[k] = dataio.gather_rows(v, idx,
+                                            nthreads=self.num_workers)
+        return out
+
     def _epoch_batches(self, epoch: int, start_batch: int = 0
                        ) -> Iterator[Batch]:
         rng = np.random.RandomState(
@@ -159,7 +191,12 @@ class DataPipeline:
         for start in range(start_batch * self.local_batch,
                            self.steps_per_epoch * self.local_batch,
                            self.local_batch):
-            batch = self.source.gather(idx[start:start + self.local_batch])
+            batch_idx = idx[start:start + self.local_batch]
+            if self._native:
+                yield self._gather_native(np.asarray(batch_idx, np.int32),
+                                          epoch, start)
+                continue
+            batch = self.source.gather(batch_idx)
             if self.augment is not None:
                 batch = self.augment(batch, rng)
             yield batch
@@ -235,7 +272,8 @@ def build_pipeline(
         return DataPipeline(
             source, local_batch, seed=seed, shuffle=train,
             augment=augment_crop_flip if train else None,
-            prefetch=cfg.prefetch,
+            prefetch=cfg.prefetch, native=cfg.use_native_loader,
+            num_workers=cfg.num_workers,
         )
 
     if name == "imagenet":
@@ -252,6 +290,7 @@ def build_pipeline(
         return DataPipeline(
             source, local_batch, seed=seed, shuffle=train,
             augment=None, prefetch=cfg.prefetch,
+            native=cfg.use_native_loader, num_workers=cfg.num_workers,
         )
 
     if name in ("wikipedia_mlm", "wmt_en_de", "coco"):
@@ -259,10 +298,14 @@ def build_pipeline(
         from .detection import build_detection_source
 
         if name == "coco":
-            source = build_detection_source(cfg, train)
+            source = build_detection_source(cfg, train,
+                                            num_classes=num_classes,
+                                            max_boxes=cfg.max_boxes)
         else:
             source = build_text_source(cfg, train)
         return DataPipeline(source, local_batch, seed=seed, shuffle=train,
-                            prefetch=cfg.prefetch)
+                            prefetch=cfg.prefetch,
+                            native=cfg.use_native_loader,
+                            num_workers=cfg.num_workers)
 
     raise KeyError(f"unknown dataset {name!r}")

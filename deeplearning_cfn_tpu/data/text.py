@@ -79,6 +79,10 @@ def make_mlm_source(num_examples: int, seq_len: int, vocab_size: int,
         input_ids[swap[:, None], np.arange(split, seq_len)[None, :]] = \
             input_ids[np.roll(swap, 1)[:, None],
                       np.arange(split, seq_len)[None, :]]
+    else:
+        # A lone positive can't swap with anyone — relabel it negative
+        # rather than train NSP on a contiguous "swapped" example.
+        nsp_label[swap] = 0
 
     mlm_positions = np.zeros((num_examples, max_pred), np.int32)
     mlm_ids = np.zeros((num_examples, max_pred), np.int32)

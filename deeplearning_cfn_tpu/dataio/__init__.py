@@ -1,0 +1,130 @@
+"""Native data-loading bindings (ctypes over dataio.cpp).
+
+Builds the shared library on first use (g++ -O3, cached beside the source)
+and exposes the batch gather/augment entry points. Everything degrades to
+None when no compiler is available — pipeline.py falls back to the Python
+path, mirroring how the reference degraded when its native input pipelines
+were unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "dataio.cpp")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "_dataio.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    # Compile to a private temp path, then rename: concurrent processes
+    # (multi-host launch, parallel pytest) must never dlopen a half-written
+    # library.
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", tmp, _SRC]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        if proc.returncode != 0 or not os.path.exists(tmp):
+            return False
+        os.replace(tmp, _LIB_PATH)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return os.path.exists(_LIB_PATH)
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library, building it if needed; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) or (
+            os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+        ):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        u64, i32, i64, f32p, i32p = (ctypes.c_uint64, ctypes.c_int,
+                                     ctypes.c_int64,
+                                     ctypes.POINTER(ctypes.c_float),
+                                     ctypes.POINTER(ctypes.c_int32))
+        lib.dlcfn_gather_augment.argtypes = [
+            f32p, i32p, f32p, i32, i32, i32, i32, i32, u64, i32, i32]
+        lib.dlcfn_gather_rows_f32.argtypes = [f32p, i32p, f32p, i32, i64, i32]
+        lib.dlcfn_gather_rows_i32.argtypes = [i32p, i32p, i32p, i32, i64, i32]
+        lib.dlcfn_version.restype = ctypes.c_int
+        if lib.dlcfn_version() != 1:
+            return None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _f32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def gather_augment(src: np.ndarray, idx: np.ndarray, pad: int, seed: int,
+                   augment: bool, nthreads: int = 4) -> np.ndarray:
+    """Batched image gather with optional crop/flip augmentation.
+
+    src [N,H,W,C] f32 contiguous; idx [B] i32 → out [B,H,W,C].
+    """
+    lib = get_lib()
+    assert lib is not None, "native dataio unavailable"
+    src = np.ascontiguousarray(src, np.float32)
+    idx = np.ascontiguousarray(idx, np.int32)
+    b = len(idx)
+    _, h, w, c = src.shape
+    out = np.empty((b, h, w, c), np.float32)
+    lib.dlcfn_gather_augment(_f32(src), _i32(idx), _f32(out), b, h, w, c,
+                             pad, seed & (2**64 - 1), int(augment), nthreads)
+    return out
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray, nthreads: int = 4
+                ) -> np.ndarray:
+    """out[b] = src[idx[b]] for f32/i32 arrays of any trailing shape."""
+    lib = get_lib()
+    assert lib is not None, "native dataio unavailable"
+    idx = np.ascontiguousarray(idx, np.int32)
+    row = int(np.prod(src.shape[1:], dtype=np.int64)) if src.ndim > 1 else 1
+    out = np.empty((len(idx),) + src.shape[1:], src.dtype)
+    if src.dtype == np.float32:
+        src = np.ascontiguousarray(src)
+        lib.dlcfn_gather_rows_f32(_f32(src), _i32(idx), _f32(out),
+                                  len(idx), row, nthreads)
+    elif src.dtype == np.int32:
+        src = np.ascontiguousarray(src)
+        lib.dlcfn_gather_rows_i32(_i32(src), _i32(idx), _i32(out),
+                                  len(idx), row, nthreads)
+    else:
+        return src[idx]
+    return out

@@ -1,0 +1,144 @@
+// Native data-loading core: threaded batch gather + augmentation.
+//
+// The reference's input pipelines ran on native threads inside MXNet/TF's
+// data engines (C++ iterators, TF tf.data kernels — SURVEY.md §3.3); the
+// rebuild's Python pipeline.py needs the same escape from the GIL for the
+// per-image augmentation loop, which is the host-side bottleneck at TPU
+// feed rates (SURVEY.md §8 hard-part #2). This file is compiled on demand
+// by build.py (g++ -O3 -shared) and bound with ctypes — no pybind11 in the
+// image, and the C ABI below keeps the surface tiny.
+//
+// Layout contracts: float32 NHWC images, C-contiguous; int32 indices.
+// Randomness: SplitMix64 seeded per (seed, image-index) pair so results are
+// deterministic and independent of thread scheduling.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// SplitMix64 — tiny, high-quality, seedable per item.
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+struct Rng {
+  uint64_t state;
+  explicit Rng(uint64_t seed) : state(seed) {}
+  uint64_t next() { state = splitmix64(state); return state; }
+  // Unbiased-enough bounded draw for small bounds.
+  uint32_t below(uint32_t bound) { return (uint32_t)(next() % bound); }
+};
+
+// Reflect-pad index: maps i in [-pad, size+pad) into [0, size).
+static inline int reflect(int i, int size) {
+  if (i < 0) return -i;
+  if (i >= size) return 2 * size - i - 2;
+  return i;
+}
+
+static void parallel_for(int n, int nthreads, void (*fn)(int, void*),
+                         void* ctx) {
+  if (nthreads <= 1) {
+    for (int i = 0; i < n; ++i) fn(i, ctx);
+    return;
+  }
+  std::atomic<int> counter{0};
+  std::vector<std::thread> threads;
+  threads.reserve(nthreads);
+  for (int t = 0; t < nthreads; ++t) {
+    threads.emplace_back([&]() {
+      for (;;) {
+        int i = counter.fetch_add(1);
+        if (i >= n) return;
+        fn(i, ctx);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+}
+
+struct GatherCtx {
+  const float* src;
+  const int32_t* idx;
+  float* out;
+  int h, w, c;
+  int pad;
+  uint64_t seed;
+  bool augment;
+};
+
+static void gather_one(int b, void* p) {
+  const GatherCtx& g = *static_cast<GatherCtx*>(p);
+  const int h = g.h, w = g.w, c = g.c;
+  const size_t img_elems = (size_t)h * w * c;
+  const float* src = g.src + (size_t)g.idx[b] * img_elems;
+  float* dst = g.out + (size_t)b * img_elems;
+  if (!g.augment) {
+    std::memcpy(dst, src, img_elems * sizeof(float));
+    return;
+  }
+  Rng rng(splitmix64(g.seed ^ (uint64_t)g.idx[b] * 0x9e3779b97f4a7c15ull ^
+                     (uint64_t)b));
+  const int dy = (int)rng.below(2 * g.pad + 1) - g.pad;
+  const int dx = (int)rng.below(2 * g.pad + 1) - g.pad;
+  const bool flip = (rng.next() & 1) != 0;
+  for (int y = 0; y < h; ++y) {
+    const int sy = reflect(y + dy, h);
+    const float* srow = src + (size_t)sy * w * c;
+    float* drow = dst + (size_t)y * w * c;
+    for (int x = 0; x < w; ++x) {
+      const int sx0 = reflect(x + dx, w);
+      const int sx = flip ? (w - 1 - sx0) : sx0;
+      std::memcpy(drow + (size_t)x * c, srow + (size_t)sx * c,
+                  c * sizeof(float));
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Gather src[idx[b]] for b in [0, batch) into out, optionally applying
+// random reflect-pad crop + horizontal flip (the CIFAR recipe).
+void dlcfn_gather_augment(const float* src, const int32_t* idx, float* out,
+                          int batch, int h, int w, int c, int pad,
+                          uint64_t seed, int augment, int nthreads) {
+  GatherCtx ctx{src, idx, out, h, w, c, pad, seed, augment != 0};
+  parallel_for(batch, nthreads, gather_one, &ctx);
+}
+
+// Plain int32/float32 row gather for label/token arrays: out[b] = src[idx[b]].
+void dlcfn_gather_rows_f32(const float* src, const int32_t* idx, float* out,
+                           int batch, int64_t row_elems, int nthreads) {
+  struct Ctx { const float* src; const int32_t* idx; float* out;
+               int64_t row; } c{src, idx, out, row_elems};
+  parallel_for(batch, nthreads, [](int b, void* p) {
+    auto& c = *static_cast<Ctx*>(p);
+    std::memcpy(c.out + (size_t)b * c.row,
+                c.src + (size_t)c.idx[b] * c.row, c.row * sizeof(float));
+  }, &c);
+}
+
+void dlcfn_gather_rows_i32(const int32_t* src, const int32_t* idx,
+                           int32_t* out, int batch, int64_t row_elems,
+                           int nthreads) {
+  struct Ctx { const int32_t* src; const int32_t* idx; int32_t* out;
+               int64_t row; } c{src, idx, out, row_elems};
+  parallel_for(batch, nthreads, [](int b, void* p) {
+    auto& c = *static_cast<Ctx*>(p);
+    std::memcpy(c.out + (size_t)b * c.row,
+                c.src + (size_t)c.idx[b] * c.row, c.row * sizeof(int32_t));
+  }, &c);
+}
+
+int dlcfn_version() { return 1; }
+
+}  // extern "C"

@@ -137,7 +137,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, causal: bool,
         jnp.zeros((block_q, d), jnp.float32),
     )
     m, l, acc = jax.lax.fori_loop(0, num_kb_live, body, init)
-    # Fully-masked rows (all -inf) have l == 0; emit zeros, not NaNs.
+    # Guard divide-by-zero for rows that saw no KV block at all (only the
+    # padded tail rows of the last q block, which the caller slices off;
+    # -1e30-bias "masked" rows still have l > 0 and softmax normally).
     out = acc / jnp.maximum(l, 1e-30)
     o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
 
@@ -275,6 +277,13 @@ def fused_attention(
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError(f"expected [B,H,S,D] inputs, got {q.shape}")
+    if causal and q.shape[-2] > k.shape[-2]:
+        # Ill-defined: ends are aligned, so the leading queries would
+        # precede every key (and the kernel/reference paths would disagree
+        # on what an all-masked softmax row means).
+        raise ValueError(
+            f"causal attention requires Sq <= Sk, got {q.shape[-2]} > "
+            f"{k.shape[-2]}")
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if implementation == "auto":
         use_pallas = jax.default_backend() == "tpu"

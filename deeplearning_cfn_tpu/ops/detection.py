@@ -1,0 +1,231 @@
+"""Detection ops, static-shape formulations for XLA/TPU.
+
+The reference's Mask R-CNN (TensorPack + Horovod — SURVEY.md §3.1) leaned on
+dynamic-shape CUDA ops: variable proposal counts, CUDA NMS, CUDA ROI-align.
+None of those survive XLA's static compilation model, so this module
+re-derives each op in fixed-shape form (SURVEY.md §8 hard-part #1):
+
+- boxes are always padded to a fixed N with a validity mask;
+- NMS is an O(K²) suppression matrix + fixed-iteration loop over top-K;
+- ROI-align is vectorized bilinear gather (vmap over boxes/batch) — no
+  scatter, no data-dependent shapes, MXU-friendly downstream.
+
+Boxes are [y0, x0, y1, x1] in feature/image coordinates (not normalized).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-8
+
+
+# -- box math ---------------------------------------------------------------
+
+
+def box_area(boxes: jnp.ndarray) -> jnp.ndarray:
+    return jnp.maximum(boxes[..., 2] - boxes[..., 0], 0) * \
+        jnp.maximum(boxes[..., 3] - boxes[..., 1], 0)
+
+
+def iou_matrix(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise IoU: a [N,4], b [M,4] → [N,M]."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = box_area(a)[:, None] + box_area(b)[None, :] - inter
+    return inter / jnp.maximum(union, EPS)
+
+
+def encode_boxes(boxes: jnp.ndarray, anchors: jnp.ndarray) -> jnp.ndarray:
+    """Box → (dy, dx, dh, dw) deltas w.r.t. anchors (R-CNN parameterization)."""
+    ah = anchors[..., 2] - anchors[..., 0]
+    aw = anchors[..., 3] - anchors[..., 1]
+    ay = anchors[..., 0] + 0.5 * ah
+    ax = anchors[..., 1] + 0.5 * aw
+    bh = boxes[..., 2] - boxes[..., 0]
+    bw = boxes[..., 3] - boxes[..., 1]
+    by = boxes[..., 0] + 0.5 * bh
+    bx = boxes[..., 1] + 0.5 * bw
+    return jnp.stack([
+        (by - ay) / jnp.maximum(ah, EPS),
+        (bx - ax) / jnp.maximum(aw, EPS),
+        jnp.log(jnp.maximum(bh, EPS) / jnp.maximum(ah, EPS)),
+        jnp.log(jnp.maximum(bw, EPS) / jnp.maximum(aw, EPS)),
+    ], axis=-1)
+
+
+def decode_boxes(deltas: jnp.ndarray, anchors: jnp.ndarray,
+                 clip_hw: Tuple[int, int] = None) -> jnp.ndarray:
+    ah = anchors[..., 2] - anchors[..., 0]
+    aw = anchors[..., 3] - anchors[..., 1]
+    ay = anchors[..., 0] + 0.5 * ah
+    ax = anchors[..., 1] + 0.5 * aw
+    # Clamp dh/dw as in Detectron (exp overflow guard; jit-safe constant).
+    dh = jnp.clip(deltas[..., 2], -4.0, 4.0)
+    dw = jnp.clip(deltas[..., 3], -4.0, 4.0)
+    by = deltas[..., 0] * ah + ay
+    bx = deltas[..., 1] * aw + ax
+    bh = jnp.exp(dh) * ah
+    bw = jnp.exp(dw) * aw
+    boxes = jnp.stack([by - 0.5 * bh, bx - 0.5 * bw,
+                       by + 0.5 * bh, bx + 0.5 * bw], axis=-1)
+    if clip_hw is not None:
+        h, w = clip_hw
+        boxes = jnp.clip(boxes, 0.0,
+                         jnp.asarray([h, w, h, w], boxes.dtype))
+    return boxes
+
+
+# -- anchors ----------------------------------------------------------------
+
+
+def generate_anchors(
+    image_hw: Tuple[int, int],
+    strides: Sequence[int],
+    scales: Sequence[float],
+    ratios: Sequence[float] = (0.5, 1.0, 2.0),
+) -> jnp.ndarray:
+    """Static anchor grid, concatenated over levels → [A_total, 4].
+
+    One scale per level (FPN convention), ``len(ratios)`` anchors per cell.
+    """
+    all_anchors: List[jnp.ndarray] = []
+    for stride, scale in zip(strides, scales):
+        # Ceil division: stride-2 SAME convs produce ceil-sized feature
+        # maps, and nested ceils collapse (ceil(ceil(H/32)/2) == ceil(H/64))
+        # — the grid must match the RPN's output cells for ANY image size.
+        fh = max(1, -(-image_hw[0] // stride))
+        fw = max(1, -(-image_hw[1] // stride))
+        cy = (jnp.arange(fh, dtype=jnp.float32) + 0.5) * stride
+        cx = (jnp.arange(fw, dtype=jnp.float32) + 0.5) * stride
+        shapes = []
+        for r in ratios:
+            h = scale * (r ** 0.5)
+            w = scale / (r ** 0.5)
+            shapes.append((h, w))
+        shapes = jnp.asarray(shapes, jnp.float32)  # [R, 2]
+        grid_y = jnp.tile(cy[:, None, None], (1, fw, len(ratios)))
+        grid_x = jnp.tile(cx[None, :, None], (fh, 1, len(ratios)))
+        hh = jnp.broadcast_to(shapes[None, None, :, 0], grid_y.shape)
+        ww = jnp.broadcast_to(shapes[None, None, :, 1], grid_y.shape)
+        anchors = jnp.stack([grid_y - hh / 2, grid_x - ww / 2,
+                             grid_y + hh / 2, grid_x + ww / 2], axis=-1)
+        all_anchors.append(anchors.reshape(-1, 4))
+    return jnp.concatenate(all_anchors, axis=0)
+
+
+# -- static NMS -------------------------------------------------------------
+
+
+def nms_static(boxes: jnp.ndarray, scores: jnp.ndarray, iou_threshold: float,
+               max_outputs: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fixed-shape NMS over the top ``max_outputs`` candidates.
+
+    Returns (indices [K] into the input, valid [K] bool). Greedy suppression
+    done with a K×K IoU matrix and a fori_loop — O(K²) but K is small
+    (≤ a few thousand) and it is all dense VPU work, no dynamic shapes.
+    """
+    k = max_outputs
+    top_scores, top_idx = jax.lax.top_k(scores, k)
+    top_boxes = boxes[top_idx]
+    iou = iou_matrix(top_boxes, top_boxes)
+    suppress_mat = (iou > iou_threshold) & ~jnp.eye(k, dtype=bool)
+
+    def body(i, keep):
+        # Box i survives iff not suppressed by any earlier kept box.
+        alive = keep[i]
+        suppressed_by_i = suppress_mat[i] & (jnp.arange(k) > i) & alive
+        return keep & ~suppressed_by_i
+
+    keep = jax.lax.fori_loop(0, k, body, jnp.ones(k, bool))
+    keep = keep & (top_scores > -jnp.inf)
+    return top_idx, keep
+
+
+# -- ROI-align --------------------------------------------------------------
+
+
+def _bilinear_sample(feat: jnp.ndarray, ys: jnp.ndarray, xs: jnp.ndarray
+                     ) -> jnp.ndarray:
+    """feat [H,W,C], sample points ys/xs [...]. Gather-based bilinear."""
+    h, w, _ = feat.shape
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1 = ys - y0.astype(ys.dtype)
+    wx1 = xs - x0.astype(xs.dtype)
+    wy0, wx0 = 1.0 - wy1, 1.0 - wx1
+    y0c = jnp.clip(y0, 0, h - 1)
+    y1c = jnp.clip(y1, 0, h - 1)
+    x0c = jnp.clip(x0, 0, w - 1)
+    x1c = jnp.clip(x1, 0, w - 1)
+    v00 = feat[y0c, x0c]
+    v01 = feat[y0c, x1c]
+    v10 = feat[y1c, x0c]
+    v11 = feat[y1c, x1c]
+    out = (v00 * (wy0 * wx0)[..., None] + v01 * (wy0 * wx1)[..., None] +
+           v10 * (wy1 * wx0)[..., None] + v11 * (wy1 * wx1)[..., None])
+    # Zero out samples fully outside the feature map.
+    inside = ((ys >= -1) & (ys <= h) & (xs >= -1) & (xs <= w))
+    return out * inside[..., None]
+
+
+def roi_align(feat: jnp.ndarray, boxes: jnp.ndarray, out_size: int,
+              spatial_scale: float = 1.0, sampling_ratio: int = 2
+              ) -> jnp.ndarray:
+    """ROI-align one feature map: feat [H,W,C], boxes [N,4] (image coords)
+    → [N, out_size, out_size, C]. 2×2 bilinear samples per output bin,
+    averaged (Detectron's sampling_ratio=2)."""
+    boxes = boxes * spatial_scale
+    n = boxes.shape[0]
+    s = sampling_ratio
+
+    def one_box(box):
+        y0, x0, y1, x1 = box[0], box[1], box[2], box[3]
+        bh = jnp.maximum(y1 - y0, EPS)
+        bw = jnp.maximum(x1 - x0, EPS)
+        cell_h = bh / out_size
+        cell_w = bw / out_size
+        # Sample grid: out_size*s points per dim, centered in sub-cells.
+        iy = (jnp.arange(out_size * s, dtype=feat.dtype) + 0.5) / s
+        ys = y0 + iy * cell_h - 0.5
+        xs = x0 + iy * cell_w - 0.5
+        yy = jnp.broadcast_to(ys[:, None], (out_size * s, out_size * s))
+        xx = jnp.broadcast_to(xs[None, :], (out_size * s, out_size * s))
+        samples = _bilinear_sample(feat, yy, xx)  # [os*s, os*s, C]
+        c = samples.shape[-1]
+        pooled = samples.reshape(out_size, s, out_size, s, c).mean((1, 3))
+        return pooled
+
+    return jax.vmap(one_box)(boxes)
+
+
+def multilevel_roi_align(
+    feats: Dict[int, jnp.ndarray],
+    boxes: jnp.ndarray,
+    out_size: int,
+    strides: Dict[int, int],
+    canonical_level: int = 4,
+    canonical_size: float = 224.0,
+) -> jnp.ndarray:
+    """FPN ROI-align: assign each box to a pyramid level by size (the FPN
+    k = k0 + log2(√area/224) rule), align on every level, and select —
+    static-shape alternative to gathering per-level subsets."""
+    levels = sorted(feats)
+    sqrt_area = jnp.sqrt(jnp.maximum(box_area(boxes), EPS))
+    target = jnp.floor(canonical_level +
+                       jnp.log2(sqrt_area / canonical_size + EPS))
+    target = jnp.clip(target, levels[0], levels[-1]).astype(jnp.int32)
+    outs = []
+    for lvl in levels:
+        outs.append(roi_align(feats[lvl], boxes, out_size,
+                              spatial_scale=1.0 / strides[lvl]))
+    stacked = jnp.stack(outs, axis=0)  # [L, N, os, os, C]
+    sel = (target[None, :] == jnp.asarray(
+        levels, jnp.int32)[:, None]).astype(stacked.dtype)
+    return jnp.einsum("lnhwc,ln->nhwc", stacked, sel)

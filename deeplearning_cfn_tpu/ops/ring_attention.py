@@ -66,9 +66,8 @@ def ring_attention(
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     q_off = my_idx * s_local
 
-    def step(carry, r):
-        m_prev, l_prev, acc_prev, kv = carry
-        k_r, v_r = kv
+    def accumulate(carry, k_r, v_r, r):
+        m_prev, l_prev, acc_prev = carry
         # After r rotations we hold the shard originally on (my_idx - r).
         src = (my_idx - r) % axis_size
         k_off = src * s_local
@@ -79,20 +78,31 @@ def ring_attention(
         alpha_cur = jnp.exp(m_cur - m_new)
         l_new = l_prev * alpha_prev + l_cur * alpha_cur
         acc_new = acc_prev * alpha_prev + pv * alpha_cur
-        # Rotate KV to the next device; XLA overlaps this ppermute with the
-        # next iteration's einsums where the schedule allows.
-        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-        k_next = jax.lax.ppermute(k_r, axis_name, perm)
-        v_next = jax.lax.ppermute(v_r, axis_name, perm)
-        return (m_new, l_new, acc_new, (k_next, v_next)), None
+        return m_new, l_new, acc_new
 
-    init = (
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, r):
+        stats, kv = carry
+        # Rotate first, then accumulate — so the local (r=0) block is done
+        # outside the loop and only axis_size-1 rotations happen in total.
+        # XLA overlaps the ppermute with the einsums where the schedule
+        # allows.
+        k_r = jax.lax.ppermute(kv[0], axis_name, perm)
+        v_r = jax.lax.ppermute(kv[1], axis_name, perm)
+        stats = accumulate(stats, k_r, v_r, r)
+        return (stats, (k_r, v_r)), None
+
+    init_stats = (
         jnp.full((b, h, s_local, 1), _NEG_INF, jnp.float32),
         jnp.zeros((b, h, s_local, 1), jnp.float32),
         jnp.zeros((b, h, s_local, d), jnp.float32),
-        (k, v),
     )
-    (m, l, acc, _), _ = jax.lax.scan(step, init, jnp.arange(axis_size))
+    stats = accumulate(init_stats, k, v, 0)  # own shard, no comm
+    if axis_size > 1:
+        (stats, _), _ = jax.lax.scan(step, (stats, (k, v)),
+                                     jnp.arange(1, axis_size))
+    m, l, acc = stats
     out = acc / jnp.maximum(l, 1e-30)
     return out.astype(q.dtype)
 

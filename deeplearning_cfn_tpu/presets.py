@@ -122,9 +122,9 @@ def _maskrcnn() -> ExperimentConfig:
         model=ModelConfig(
             name="maskrcnn_resnet50",
             num_classes=91,
-            kwargs=dict(image_size=1024, max_boxes=100),
+            kwargs=dict(image_size=1024),  # GT padding is data.max_boxes
         ),
-        data=DataConfig(name="coco", image_size=1024),
+        data=DataConfig(name="coco", image_size=1024, max_boxes=100),
         train=TrainConfig(global_batch=64, epochs=24.0, dtype="bfloat16"),
         optimizer=OptimizerConfig(name="momentum", momentum=0.9,
                                   weight_decay=1e-4, grad_clip_norm=10.0),

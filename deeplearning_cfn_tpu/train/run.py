@@ -76,7 +76,8 @@ def run_experiment(
                 print(f"[dlcfn-tpu] resumed from step {at_step}")
 
     trainer = Trainer(cfg, task.loss_fn, tx, mesh=mesh,
-                      spatial_dim=getattr(task, "spatial_dim", None))
+                      spatial_dim=getattr(task, "spatial_dim", None),
+                      spatial_keys=getattr(task, "spatial_keys", None))
     metrics_path = os.path.join(workdir, "metrics.jsonl")
     writer = MetricsWriter(metrics_path)
     if jax.process_index() == 0:

@@ -52,6 +52,7 @@ class Trainer:
         tx,
         mesh: Optional[Mesh] = None,
         spatial_dim: Optional[int] = None,
+        spatial_keys: Optional[Tuple[str, ...]] = None,
         donate: bool = True,
     ):
         self.cfg = cfg
@@ -60,16 +61,27 @@ class Trainer:
         self.mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
         validate_batch(cfg.train.global_batch, self.mesh)
         self.spatial_dim = spatial_dim
+        # Which batch keys the spatial shard applies to (None = any array
+        # with >=4 dims). Detection restricts it to "image" — its mask
+        # targets are also 4-D but their dim 1 is a box count, not height.
+        self.spatial_keys = spatial_keys
         self._train_step = None
         self._eval_step = None
         self._donate = donate
 
     # -- sharding helpers ---------------------------------------------------
 
+    def _spatial_for(self, key: str, ndim: int) -> Optional[int]:
+        if ndim < 4 or self.spatial_dim is None:
+            return None
+        if self.spatial_keys is not None and key not in self.spatial_keys:
+            return None
+        return self.spatial_dim
+
     def batch_shardings(self, batch: Batch):
         return {
-            k: batch_sharding(self.mesh, np.ndim(v), self.spatial_dim
-                              if np.ndim(v) >= 4 else None)
+            k: batch_sharding(self.mesh, np.ndim(v),
+                              self._spatial_for(k, np.ndim(v)))
             for k, v in batch.items()
         }
 
@@ -79,7 +91,7 @@ class Trainer:
         out = {}
         for k, v in batch.items():
             sh = batch_sharding(self.mesh, v.ndim,
-                                self.spatial_dim if v.ndim >= 4 else None)
+                                self._spatial_for(k, v.ndim))
             global_shape = (gb,) + tuple(v.shape[1:])
             if jax.process_count() == 1:
                 out[k] = jax.device_put(v, sh)
