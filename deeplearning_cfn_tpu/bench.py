@@ -51,11 +51,17 @@ def run_bench(
         cfg.train.global_batch = global_batch
     elif jax.device_count() == 1:
         # Single-chip bench: a per-chip-sized batch, not the pod-sized one.
-        per_chip = {"imagenet_resnet50": 128, "cifar10_resnet20": 512,
+        # Measured on v5p (2026-07): 512 beats 128 by ~1.7x for ResNet-50
+        # (MXU utilization; step time still < 0.3 s).
+        per_chip = {"imagenet_resnet50": 512, "cifar10_resnet20": 512,
                     "bert_base_wikipedia": 32, "transformer_nmt_wmt": 64,
                     "maskrcnn_coco": 1}.get(preset, 64)
         cfg.train.global_batch = per_chip
     apply_overrides(cfg, ["data.prefetch=0", "data.synthetic=true"])
+    # One batch is all the bench consumes — don't materialize the default
+    # multi-GB synthetic dataset (8192×224² ImageNet ≈ 5 GB host RAM).
+    cfg.data.num_train_examples = cfg.train.global_batch
+    cfg.data.num_eval_examples = cfg.train.global_batch
 
     mesh = mesh if mesh is not None else build_mesh(MeshConfig(data=-1))
     n_chips = mesh.devices.size
