@@ -1,36 +1,105 @@
 """Headline benchmark: ResNet-50 training throughput, images/sec/chip.
 
 Driver contract: prints ONE JSON line {"metric", "value", "unit",
-"vs_baseline"}. Runs the flagship north-star workload (BASELINE.json:
-"ResNet-50/ImageNet images/sec/chip") as a single-chip training-step
-benchmark on whatever accelerator is attached, by delegating to the
-in-package harness (deeplearning_cfn_tpu/bench.py run_bench) — full train
-step (fwd + bwd + LARS update) on synthetic ImageNet-shaped data, bf16
-compute, donated buffers; sync via scalar device→host reads (some PJRT
-transports complete ready-events before execution finishes).
+"vs_baseline"} (plus "mfu" and diagnostics). The measurement itself lives in
+deeplearning_cfn_tpu/bench.py (full train step — fwd + bwd + LARS update —
+on synthetic ImageNet-shaped data, bf16, donated buffers, pipelined timed
+block with one trailing data-dependent sync, MFU from XLA cost analysis).
+
+This wrapper exists for resilience: on this image the TPU backend ("axon"
+plugin) is flaky — init can FAIL (r01: RuntimeError at jax.device_count) or
+HANG (judge repro: process blocked at ~0 CPU for 600 s). An in-process
+retry cannot recover from a hang, so each attempt runs the measurement in a
+fresh subprocess with a hard timeout, retrying with backoff; a fresh process
+also guarantees retries aren't poisoned by jax's cached failed-backend
+state. If every attempt fails, the contract JSON is still printed with an
+"error" field — the driver always gets a parseable record, never a
+traceback.
+
+Do NOT force the CPU backend here: this runs on the real chip.
 
 vs_baseline: the reference repo publishes no numbers (BASELINE.json
 "published": {}), so the ratio is computed against the external context
 anchor recorded in BASELINE.md — TF+Horovod ResNet-50 at ~375 images/sec per
 V100 GPU (Horovod paper arXiv:1802.05799), the stack the reference's
-flagship workload ran on. Do NOT force the CPU backend here: this runs on
-the real chip.
+flagship workload ran on.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
+import time
+
+METRIC = "imagenet_resnet50_train_images_per_sec_per_chip"
+UNIT = "images/sec/chip"
+ATTEMPT_TIMEOUT_S = int(os.environ.get("DLCFN_BENCH_ATTEMPT_TIMEOUT_S",
+                                       "300"))  # normal run ~2-3 min
+# Hard wall for the whole wrapper: it must finish (and print the contract
+# JSON) before the DRIVER's own timeout kills it — r01's harness killed the
+# multichip gate at ~600 s, so stay safely under that.
+TOTAL_BUDGET_S = int(os.environ.get("DLCFN_BENCH_TOTAL_BUDGET_S", "540"))
+BACKOFFS_S = (0.0, 10.0, 20.0)  # sleep before each attempt
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 
 
-def main():
-    from deeplearning_cfn_tpu.bench import run_bench
+def _parse_record(stdout: str):
+    for line in reversed((stdout or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "metric" in rec and "value" in rec:
+                return rec
+    return None
 
-    record = run_bench(preset="imagenet_resnet50", steps=20, warmup=4)
+
+def main() -> None:
+    child = [
+        sys.executable, "-m", "deeplearning_cfn_tpu.bench",
+        "--preset", "imagenet_resnet50", "--steps", "30", "--warmup", "5",
+    ]
+    errors = []
+    deadline = time.monotonic() + TOTAL_BUDGET_S
+    for i, backoff in enumerate(BACKOFFS_S):
+        if backoff:
+            time.sleep(backoff)
+        remaining = deadline - time.monotonic()
+        if remaining < 60:
+            errors.append(f"attempt {i + 1}: skipped, total budget "
+                          f"({TOTAL_BUDGET_S}s) exhausted")
+            break
+        attempt_timeout = min(ATTEMPT_TIMEOUT_S, int(remaining))
+        try:
+            proc = subprocess.run(
+                child, capture_output=True, text=True,
+                timeout=attempt_timeout, cwd=REPO_ROOT,
+            )
+        except subprocess.TimeoutExpired:
+            errors.append(
+                f"attempt {i + 1}: timeout after {attempt_timeout}s "
+                "(TPU backend init can hang on this image)"
+            )
+            continue
+        record = _parse_record(proc.stdout)
+        if proc.returncode == 0 and record is not None:
+            print(json.dumps(record))
+            return
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-6:]
+        errors.append(
+            f"attempt {i + 1}: rc={proc.returncode}: " + " | ".join(tail)
+        )
     print(json.dumps({
-        "metric": "resnet50_train_images_per_sec_per_chip",
-        "value": record["value"],
-        "unit": record["unit"],
-        "vs_baseline": record["vs_baseline"],
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": UNIT,
+        "vs_baseline": 0.0,
+        "mfu": 0.0,
+        "error": " || ".join(errors)[-2000:],
     }))
 
 

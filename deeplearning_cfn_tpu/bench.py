@@ -8,6 +8,7 @@ case of this harness for the driver contract.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
 # External context anchor (BASELINE.md): TF+Horovod ResNet-50 on V100, the
@@ -22,6 +23,45 @@ _UNITS = {
     "bert_base_wikipedia": "sequences/sec/chip",
     "transformer_nmt_wmt": "sequences/sec/chip",
 }
+
+# Peak dense bf16 FLOPs/sec per chip, keyed by device_kind substring.
+# Order matters: more specific kinds first ("v5p" before "v5").
+_PEAK_FLOPS_BF16 = (
+    ("v6", 918e12),
+    ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def peak_flops_per_chip(device) -> Optional[float]:
+    """Peak bf16 FLOPs/sec for ``device``, or None if unknown (e.g. CPU)."""
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in _PEAK_FLOPS_BF16:
+        if key in kind:
+            return peak
+    return None
+
+
+def _flops_of(compiled) -> Optional[float]:
+    """Per-device FLOPs of one execution of an AOT-compiled step, from XLA's
+    own cost analysis (no hand-derived model FLOP formula to drift out of
+    date). The analyzed module is the post-GSPMD per-device program, so the
+    number is already per-chip."""
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else {}
+        flops = float(analysis.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
 
 
 def run_bench(
@@ -40,7 +80,6 @@ def run_bench(
     from .data import build_pipeline
     from .parallel.mesh import build_mesh, local_batch_size
     from .presets import get_preset
-    from .runtime.profiling import StepTimer
     from .train import create_train_state
     from .train.optim import build_optimizer, build_schedule
     from .train.task import build_task
@@ -82,21 +121,37 @@ def run_bench(
     dev_batch = trainer.device_batch(host_batch)
     step_rng = jax.random.PRNGKey(1)
 
-    timer = StepTimer(warmup=0)
-    # Warmup (compile + cache); sync via a scalar device→host read — some
+    # One AOT compile, reused for execution AND cost analysis — calling
+    # trainer.train_step would jit-compile a second, separate executable.
+    compiled_step = trainer.train_step.lower(
+        state, dev_batch, step_rng).compile()
+
+    # Warmup (cache effects); sync via a scalar device→host read — some
     # PJRT transports complete ready-events before execution finishes.
     for _ in range(max(warmup, 1)):
-        state, m = trainer.train_step(state, dev_batch, step_rng)
+        state, m = compiled_step(state, dev_batch, step_rng)
     float(m["loss"])
 
+    # Timed block: dispatch every step back-to-back with NO per-step sync —
+    # steady-state pipelined throughput, the number that matters at pod
+    # scale — then one trailing sync. The final scalar read is data-dependent
+    # on every step (state chains through the loop), so it cannot complete
+    # before all the work has, even on transports whose ready-events fire
+    # early.
+    t0 = time.perf_counter()
     for _ in range(steps):
-        timer.start()
-        state, m = trainer.train_step(state, dev_batch, step_rng)
-        float(m["loss"])
-        timer.stop()
+        state, m = compiled_step(state, dev_batch, step_rng)
+    float(m["loss"])
+    mean_step_s = (time.perf_counter() - t0) / steps
 
-    summary = timer.summary(items_per_step=gb)
-    per_chip = gb / summary["mean_step_s"] / n_chips
+    # MFU: XLA-counted per-device FLOPs per step vs one chip's peak bf16
+    # rate. 0.0 when the peak is unknown (CPU runs) or cost analysis is
+    # unavailable.
+    flops = _flops_of(compiled_step)
+    peak = peak_flops_per_chip(jax.devices()[0])
+    mfu = flops / (mean_step_s * peak) if flops and peak else 0.0
+
+    per_chip = gb / mean_step_s / n_chips
     unit = _UNITS.get(preset, "items/sec/chip")
     record = {
         "metric": f"{preset}_train_{unit.split('/')[0]}_per_sec_per_chip",
@@ -106,9 +161,32 @@ def run_bench(
         # it is only meaningful for that preset.
         "vs_baseline": round(per_chip / HOROVOD_V100_IMG_PER_SEC_PER_GPU, 3)
         if preset == "imagenet_resnet50" else 0.0,
+        "mfu": round(mfu, 4),
         "steps": steps,
         "global_batch": gb,
         "n_chips": n_chips,
-        "mean_step_s": round(summary["mean_step_s"], 5),
+        "mean_step_s": round(mean_step_s, 5),
+        "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
     }
     return record
+
+
+def main(argv=None) -> None:
+    """Child-process entry for the driver bench (see root ``bench.py``):
+    run one preset and print the contract JSON line."""
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--preset", default="imagenet_resnet50")
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--warmup", type=int, default=5)
+    parser.add_argument("--global-batch", type=int, default=0)
+    args = parser.parse_args(argv)
+    record = run_bench(preset=args.preset, steps=args.steps,
+                       warmup=args.warmup, global_batch=args.global_batch)
+    print(json.dumps(record), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -167,6 +167,14 @@ def _flash_forward(q, k, v, bias, causal, sm_scale, interpret=False):
     kp = _pad_to(k, 2, block_k)
     vp = _pad_to(v, 2, block_k)
     sq_p, sk_p = qp.shape[2], kp.shape[2]
+    if bias is not None and bias.shape[-1] == 1:
+        # The contract is "broadcastable to [B,H,Sq,Sk]"; a bias constant
+        # across the K (softmax) axis shifts every logit in a row equally,
+        # and softmax is invariant to that — it contributes nothing to the
+        # output. Drop it instead of materializing [...,Sk] (its gradient,
+        # exactly zero, still flows via the custom VJP's reference
+        # recompute, which sees the original bias).
+        bias = None
     if bias is not None:
         # Align the user bias's K axis with the padded KV (zeros are fine:
         # the pad_bias below kills padded columns).

@@ -123,14 +123,21 @@ def generate_anchors(
 
 
 def nms_static(boxes: jnp.ndarray, scores: jnp.ndarray, iou_threshold: float,
-               max_outputs: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+               max_outputs: int, valid: jnp.ndarray = None
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Fixed-shape NMS over the top ``max_outputs`` candidates.
 
     Returns (indices [K] into the input, valid [K] bool). Greedy suppression
     done with a K×K IoU matrix and a fori_loop — O(K²) but K is small
     (≤ a few thousand) and it is all dense VPU work, no dynamic shapes.
+
+    Padding: pass ``valid`` (bool [N]) to mark real candidates explicitly;
+    otherwise any score below the large-negative sentinel threshold
+    (covers both -inf and the -1e30 convention) is treated as padding.
     """
     k = max_outputs
+    if valid is not None:
+        scores = jnp.where(valid, scores, -jnp.inf)
     top_scores, top_idx = jax.lax.top_k(scores, k)
     top_boxes = boxes[top_idx]
     iou = iou_matrix(top_boxes, top_boxes)
@@ -143,7 +150,7 @@ def nms_static(boxes: jnp.ndarray, scores: jnp.ndarray, iou_threshold: float,
         return keep & ~suppressed_by_i
 
     keep = jax.lax.fori_loop(0, k, body, jnp.ones(k, bool))
-    keep = keep & (top_scores > -jnp.inf)
+    keep = keep & (top_scores > -5e29)  # padding sentinel threshold (-1e30/2)
     return top_idx, keep
 
 

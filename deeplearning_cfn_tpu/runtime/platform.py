@@ -1,0 +1,55 @@
+"""Backend-platform selection workarounds, shared by every entry point.
+
+This image's sitecustomize pre-registers a TPU ("axon") PJRT plugin whose
+backend init can hang or fail; setting ``JAX_PLATFORMS=cpu`` in the
+environment is too late once that registration has run, so selecting the CPU
+backend requires BOTH the env vars and an in-process
+``jax.config.update("jax_platforms", ...)`` before the first jax call that
+initializes backends. This module is the single home for that fact — the
+r01 multichip-gate timeout happened precisely because one of three divergent
+hand-rolled copies of the workaround was missing it.
+
+Importing this module does not touch jax backends; jax is imported lazily
+inside the functions.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+
+def force_cpu_platform(n_devices: Optional[int] = None) -> None:
+    """Force the CPU backend, optionally with ``n_devices`` virtual devices.
+
+    Must run before any jax call that initializes backends (``jax.devices``,
+    ``device_count``, jit execution). Replaces any preexisting
+    ``--xla_force_host_platform_device_count`` value — keeping a stale count
+    would make device-count asserts fail for an environmental reason.
+    """
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        flag = f"--xla_force_host_platform_device_count={n_devices}"
+        if "xla_force_host_platform_device_count" in flags:
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags)
+        else:
+            flags = (flags + " " + flag).strip()
+        os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def honor_env_platform() -> None:
+    """Apply ``JAX_PLATFORMS`` from the environment in-process (the worker
+    path: dry-run stacks simulate hosts as local CPU processes by exporting
+    it, and the env var alone is too late on this image)."""
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)

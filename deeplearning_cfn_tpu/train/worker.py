@@ -32,15 +32,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="config overrides, e.g. train.global_batch=256")
     args = parser.parse_args(argv)
 
-    # Some images pre-register accelerator PJRT plugins from sitecustomize,
-    # where the env var alone is too late to pick the backend — honor it
-    # explicitly before first jax use (dry-run stacks simulate hosts as
-    # local CPU processes this way).
-    platforms = os.environ.get("JAX_PLATFORMS", "")
-    if platforms:
-        import jax
+    from ..runtime.platform import honor_env_platform
 
-        jax.config.update("jax_platforms", platforms)
+    honor_env_platform()
 
     spec = initialize()  # no-op single-host; rendezvous when contract present
     if args.profiler_port:

@@ -8,21 +8,17 @@ setup at conftest import time.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# This image's sitecustomize pre-registers a TPU PJRT plugin before conftest
+# runs, so the env var alone is too late — the shared helper also switches
+# the platform in-process, before any backend initializes.
+from deeplearning_cfn_tpu.runtime.platform import force_cpu_platform  # noqa: E402
+
+force_cpu_platform(8)
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
-
-# This image's sitecustomize pre-registers a TPU PJRT plugin before conftest
-# runs, so the env var alone is too late — switch in-process too. The CPU
-# client itself initializes lazily, after our XLA_FLAGS edit above.
-jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture(scope="session")

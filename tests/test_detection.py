@@ -72,6 +72,30 @@ def test_nms_static_suppresses():
     assert kept == {0, 2}
 
 
+def test_nms_static_padding_sentinels_and_valid_mask():
+    """Padded candidates must never appear in the output — whether marked
+    by the finite -1e30 sentinel convention or by an explicit validity
+    mask (regression: exact -inf was the only recognized padding)."""
+    boxes = jnp.asarray([
+        [0, 0, 10, 10],
+        [50, 50, 60, 60],
+        [0, 0, 0, 0],      # padding
+        [0, 0, 0, 0],      # padding
+    ], jnp.float32)
+    scores = jnp.asarray([0.9, 0.8, -1e30, -1e30])
+    idx, keep = nms_static(boxes, scores, iou_threshold=0.5, max_outputs=4)
+    kept = set(np.asarray(idx)[np.asarray(keep)].tolist())
+    assert kept == {0, 1}
+
+    # Explicit validity mask overrides scores: box 1 is masked out even
+    # though its score is high.
+    valid = jnp.asarray([True, False, False, False])
+    idx, keep = nms_static(boxes, scores, iou_threshold=0.5, max_outputs=4,
+                           valid=valid)
+    kept = set(np.asarray(idx)[np.asarray(keep)].tolist())
+    assert kept == {0}
+
+
 def test_roi_align_identity_crop():
     """Aligning a box that covers exactly the feature map reproduces it
     (up to bilinear smoothing at the bin centers)."""

@@ -75,11 +75,14 @@ def test_fault_injection_restart_resumes(tmp_path):
     """Kill-a-host fault injection: rank 1 crashes on the first attempt;
     the launcher restarts the whole job and the second attempt 'resumes'
     (observes prior attempt's marker) and succeeds."""
-    marker = tmp_path / "attempt0_happened"
+    # Per-rank markers: a shared marker would race — if rank 0 wrote it
+    # before rank 1's interpreter started, rank 1 would skip the injected
+    # crash and the job would succeed with restarts=0.
+    marker = tmp_path / "attempt0_rank"
     code = (
         "import os, sys\n"
         f"rank = int(os.environ['{ENV_PROCESS_ID}'])\n"
-        f"marker = r'{marker}'\n"
+        f"marker = r'{marker}' + str(rank)\n"
         "if not os.path.exists(marker):\n"
         "    open(marker, 'w').write('x')\n"
         "    sys.exit(7) if rank == 1 else sys.exit(0)\n"

@@ -109,6 +109,26 @@ def test_flash_causal_with_block_padding(sq, sk):
                                atol=2e-5, rtol=2e-5)
 
 
+def test_flash_bias_broadcast_k_dim():
+    """Bias with K dim == 1 (broadcast over keys, e.g. a per-query additive
+    term): the contract is 'broadcastable to [B,H,Sq,Sk]' and the reference
+    path accepts it, so the kernel path must agree (regression: used to
+    raise ValueError)."""
+    q, k, v = _qkv(b=1, h=2, sq=64, sk=72, d=16, seed=5)
+    bias = jnp.asarray(
+        np.random.RandomState(6).normal(0, 1, (1, 1, 64, 1)), jnp.float32)
+    ref = attention_reference(q, k, v, bias=bias)
+    out = fused_attention(q, k, v, bias=bias, implementation="interpret")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    # And combined with causal masking (kv block padding in play: sk=72).
+    ref_c = attention_reference(q, k, v, bias=bias, causal=True)
+    out_c = fused_attention(q, k, v, bias=bias, causal=True,
+                            implementation="interpret")
+    np.testing.assert_allclose(np.asarray(out_c), np.asarray(ref_c),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_flash_bias_with_kv_padding():
     """User bias [B,1,1,sk] where sk needs block padding (regression: used
     to crash on shape mismatch when adding the pad bias)."""
