@@ -1,0 +1,8 @@
+"""``python -m deeplearning_cfn_tpu.cli`` → the dlcfn-tpu command."""
+
+import sys
+
+from .main import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -215,6 +215,41 @@ def _cmd_bench(args) -> int:
     return 0
 
 
+def _cmd_data_prepare_imagenet(args) -> int:
+    from ..data.imagenet import prepare_imagenet
+
+    index = prepare_imagenet(args.src, args.out, size=args.size,
+                             shard_records=args.shard_records,
+                             limit=args.limit or None)
+    n = sum(s["num_records"] for s in index["shards"])
+    print(f"[dlcfn-tpu] wrote {n} records in {len(index['shards'])} shards "
+          f"({index['num_classes']} classes) to {args.out}")
+    return 0
+
+
+def _cmd_data_feed_rate(args) -> int:
+    # Host-side measurement only — never initialize an accelerator backend
+    # (the pipeline queries process_index for sharding).
+    from ..runtime.platform import force_cpu_platform
+
+    force_cpu_platform()
+
+    from ..data import build_pipeline
+    from ..data.imagenet import measure_feed_rate
+
+    cfg = apply_overrides(get_preset(args.preset), args.overrides)
+    if not any(o.startswith("data.prefetch=") for o in args.overrides):
+        # Measure raw producer rate: a prefetch queue that starts full
+        # would inflate the first `depth` timed batches.
+        cfg.data.prefetch = 0
+    pipe = build_pipeline(cfg.data, args.local_batch,
+                          cfg.model.num_classes, seed=0, train=True)
+    rate = measure_feed_rate(pipe, num_batches=args.batches)
+    print(json.dumps({"metric": f"{args.preset}_feed_images_per_sec",
+                      **{k: round(v, 2) for k, v in rate.items()}}))
+    return 0
+
+
 def _add_stack_args(p: argparse.ArgumentParser) -> None:
     defaults = StackConfig()
     p.add_argument("--state-dir", default=defaults.state_dir)
@@ -292,6 +327,32 @@ def build_parser() -> argparse.ArgumentParser:
     be.add_argument("--steps", type=int, default=30)
     be.add_argument("--global-batch", type=int, default=0)
     be.set_defaults(fn=_cmd_bench)
+
+    # data -------------------------------------------------------------------
+    data = sub.add_parser("data", help="dataset preparation / diagnostics")
+    dsub = data.add_subparsers(dest="data_command", required=True)
+
+    dp = dsub.add_parser(
+        "prepare-imagenet",
+        help="JPEG class-dir tree → dlcfn binary shards (run per split)")
+    dp.add_argument("--src", required=True,
+                    help="class-per-subdirectory image tree")
+    dp.add_argument("--out", required=True, help="output shard directory")
+    dp.add_argument("--size", type=int, default=256,
+                    help="stored square resolution (default 256)")
+    dp.add_argument("--shard-records", type=int, default=8192)
+    dp.add_argument("--limit", type=int, default=0,
+                    help="stop after N images (smoke tests)")
+    dp.set_defaults(fn=_cmd_data_prepare_imagenet)
+
+    df = dsub.add_parser(
+        "feed-rate",
+        help="host-side input pipeline throughput (images/sec)")
+    df.add_argument("--preset", default="imagenet_resnet50")
+    df.add_argument("--local-batch", type=int, default=256)
+    df.add_argument("--batches", type=int, default=30)
+    df.add_argument("overrides", nargs="*")
+    df.set_defaults(fn=_cmd_data_feed_rate)
 
     return parser
 

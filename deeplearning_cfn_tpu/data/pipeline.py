@@ -138,10 +138,15 @@ class DataPipeline:
         self.pidx = jax.process_index() if process_index is None else process_index
         self.pcount = jax.process_count() if process_count is None else process_count
         self.num_workers = max(1, num_workers)
+        # Sources exposing gather_seeded (ImageNet shards) do their own
+        # augmentation/decode — the pipeline just hands them a seed.
+        self._seeded = hasattr(source, "gather_seeded") and augment is None
         # Native path handles the plain and crop/flip cases; anything else
         # (custom augment fns, sources overriding gather) stays in Python.
         self._native = False
-        if native and (augment is None or augment is augment_crop_flip) \
+        if not self._seeded and native \
+                and (augment is None or augment is augment_crop_flip) \
+                and isinstance(source, ArraySource) \
                 and type(source).gather is ArraySource.gather:
             from .. import dataio
 
@@ -192,6 +197,15 @@ class DataPipeline:
                            self.steps_per_epoch * self.local_batch,
                            self.local_batch):
             batch_idx = idx[start:start + self.local_batch]
+            if self._seeded:
+                # Seeded-gather sources (ImageNet shards) own their
+                # augmentation; the (seed, epoch, offset, process) mix makes
+                # it deterministic and resume-stable.
+                seed = ((self.seed + 1) * 7919 + epoch * 2654435761 +
+                        start * 31 + self.pidx) & (2**64 - 1)
+                yield self.source.gather_seeded(
+                    np.asarray(batch_idx, np.int64), seed)
+                continue
             if self._native:
                 yield self._gather_native(np.asarray(batch_idx, np.int32),
                                           epoch, start)

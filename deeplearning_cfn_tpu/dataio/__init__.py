@@ -68,12 +68,23 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                      ctypes.c_int64,
                                      ctypes.POINTER(ctypes.c_float),
                                      ctypes.POINTER(ctypes.c_int32))
-        lib.dlcfn_gather_augment.argtypes = [
-            f32p, i32p, f32p, i32, i32, i32, i32, i32, u64, i32, i32]
-        lib.dlcfn_gather_rows_f32.argtypes = [f32p, i32p, f32p, i32, i64, i32]
-        lib.dlcfn_gather_rows_i32.argtypes = [i32p, i32p, i32p, i32, i64, i32]
-        lib.dlcfn_version.restype = ctypes.c_int
-        if lib.dlcfn_version() != 1:
+        # Version gate BEFORE symbol binding: a stale library that dodged
+        # the mtime check (same-second checkout, copied tree) must degrade
+        # to the Python path, not crash on a missing symbol.
+        try:
+            lib.dlcfn_version.restype = ctypes.c_int
+            if lib.dlcfn_version() != 2:
+                return None
+            lib.dlcfn_gather_augment.argtypes = [
+                f32p, i32p, f32p, i32, i32, i32, i32, i32, u64, i32, i32]
+            lib.dlcfn_gather_rows_f32.argtypes = [
+                f32p, i32p, f32p, i32, i64, i32]
+            lib.dlcfn_gather_rows_i32.argtypes = [
+                i32p, i32p, i32p, i32, i64, i32]
+            lib.dlcfn_crop_resize_norm.argtypes = [
+                ctypes.POINTER(u64), i32, i32, f32p, i32, i32, u64, i32,
+                f32p, f32p, i32]
+        except AttributeError:
             return None
         _lib = lib
         return _lib
@@ -106,6 +117,31 @@ def gather_augment(src: np.ndarray, idx: np.ndarray, pad: int, seed: int,
     out = np.empty((b, h, w, c), np.float32)
     lib.dlcfn_gather_augment(_f32(src), _i32(idx), _f32(out), b, h, w, c,
                              pad, seed & (2**64 - 1), int(augment), nthreads)
+    return out
+
+
+def crop_resize_norm(src_ptrs: np.ndarray, src_hw, out_size: int,
+                     seed: int, augment: bool, mean: np.ndarray,
+                     std: np.ndarray, nthreads: int = 4) -> np.ndarray:
+    """Batched u8 record → cropped/resized/normalized f32 [B,S,S,3].
+
+    ``src_ptrs``: uint64 array of B addresses, each pointing at a contiguous
+    u8 HWC image payload of shape ``src_hw + (3,)`` (e.g. records inside
+    mmap'd ImageNet shards). Augmentation (random-resized-crop + flip) is
+    deterministic per (seed, batch position); see dataio.cpp for the RNG
+    contract shared with the Python fallback.
+    """
+    lib = get_lib()
+    assert lib is not None, "native dataio unavailable"
+    src_ptrs = np.ascontiguousarray(src_ptrs, np.uint64)
+    mean = np.ascontiguousarray(mean, np.float32)
+    std = np.ascontiguousarray(std, np.float32)
+    b = len(src_ptrs)
+    out = np.empty((b, out_size, out_size, 3), np.float32)
+    lib.dlcfn_crop_resize_norm(
+        src_ptrs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        int(src_hw[0]), int(src_hw[1]), _f32(out), b, out_size,
+        seed & (2**64 - 1), int(augment), _f32(mean), _f32(std), nthreads)
     return out
 
 

@@ -13,6 +13,7 @@
 // deterministic and independent of thread scheduling.
 
 #include <atomic>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <thread>
@@ -102,9 +103,118 @@ static void gather_one(int b, void* p) {
   }
 }
 
+// ---------------------------------------------------------------------------
+// ImageNet hot path: u8 record -> random-resized-crop / center-crop ->
+// bilinear resize -> flip -> normalize -> f32 NHWC.
+//
+// The RNG draw ORDER below is a contract: the Python fallback in
+// data/imagenet.py replicates it draw-for-draw so native and fallback
+// pipelines produce identical augmentation for the same seed.
+// ---------------------------------------------------------------------------
+
+static inline double uniform01(Rng& rng) {
+  return (double)(rng.next() >> 11) * (1.0 / 9007199254740992.0);  // 53-bit
+}
+
+struct CropCtx {
+  const uint64_t* src_ptrs;  // batch pointers to u8 HWC image payloads
+  int src_h, src_w;
+  float* out;
+  int out_size;
+  uint64_t seed;
+  bool augment;
+  const float* mean;  // [3]
+  const float* stddev;  // [3]
+};
+
+static void crop_resize_one(int b, void* p) {
+  const CropCtx& g = *static_cast<CropCtx*>(p);
+  const int H = g.src_h, W = g.src_w, S = g.out_size;
+  const uint8_t* src = reinterpret_cast<const uint8_t*>(g.src_ptrs[b]);
+  float* dst = g.out + (size_t)b * S * S * 3;
+  Rng rng(splitmix64(g.seed ^ ((uint64_t)(b + 1) * 0x9e3779b97f4a7c15ull)));
+
+  int y0 = 0, x0 = 0, ch = H, cw = W;
+  bool flip = false;
+  if (g.augment) {
+    // torchvision-style RandomResizedCrop: area in [0.08, 1], aspect in
+    // [3/4, 4/3], 10 attempts then center-crop fallback.
+    const double area = (double)H * W;
+    bool found = false;
+    for (int attempt = 0; attempt < 10 && !found; ++attempt) {
+      const double target_area = (0.08 + uniform01(rng) * 0.92) * area;
+      const double log_lo = std::log(3.0 / 4.0), log_hi = std::log(4.0 / 3.0);
+      const double ar = std::exp(log_lo + uniform01(rng) * (log_hi - log_lo));
+      const int w_c = (int)std::floor(std::sqrt(target_area * ar) + 0.5);
+      const int h_c = (int)std::floor(std::sqrt(target_area / ar) + 0.5);
+      if (w_c > 0 && h_c > 0 && w_c <= W && h_c <= H) {
+        y0 = (int)rng.below((uint32_t)(H - h_c + 1));
+        x0 = (int)rng.below((uint32_t)(W - w_c + 1));
+        ch = h_c;
+        cw = w_c;
+        found = true;
+      }
+    }
+    if (!found) {
+      ch = cw = H < W ? H : W;
+      y0 = (H - ch) / 2;
+      x0 = (W - cw) / 2;
+    }
+    flip = (rng.next() & 1) != 0;
+  } else {
+    // Eval: center crop of the shorter side (sources are pre-resized so
+    // this is the classic resize-256 / center-crop-224 recipe's tail).
+    ch = cw = H < W ? H : W;
+    y0 = (H - ch) / 2;
+    x0 = (W - cw) / 2;
+  }
+
+  for (int r = 0; r < S; ++r) {
+    const double fy = y0 + ((double)r + 0.5) * ch / S - 0.5;
+    int yi = (int)std::floor(fy);
+    const float wy1 = (float)(fy - yi);
+    int y0i = yi < 0 ? 0 : (yi > H - 1 ? H - 1 : yi);
+    int y1i = yi + 1 < 0 ? 0 : (yi + 1 > H - 1 ? H - 1 : yi + 1);
+    const uint8_t* row0 = src + (size_t)y0i * W * 3;
+    const uint8_t* row1 = src + (size_t)y1i * W * 3;
+    float* drow = dst + (size_t)r * S * 3;
+    for (int c = 0; c < S; ++c) {
+      const int cc = flip ? (S - 1 - c) : c;
+      const double fx = x0 + ((double)cc + 0.5) * cw / S - 0.5;
+      int xi = (int)std::floor(fx);
+      const float wx1 = (float)(fx - xi);
+      int x0i = xi < 0 ? 0 : (xi > W - 1 ? W - 1 : xi);
+      int x1i = xi + 1 < 0 ? 0 : (xi + 1 > W - 1 ? W - 1 : xi + 1);
+      for (int k = 0; k < 3; ++k) {
+        const float v00 = row0[(size_t)x0i * 3 + k];
+        const float v01 = row0[(size_t)x1i * 3 + k];
+        const float v10 = row1[(size_t)x0i * 3 + k];
+        const float v11 = row1[(size_t)x1i * 3 + k];
+        const float top = v00 + (v01 - v00) * wx1;
+        const float bot = v10 + (v11 - v10) * wx1;
+        const float v = top + (bot - top) * wy1;
+        drow[(size_t)c * 3 + k] =
+            (v * (1.0f / 255.0f) - g.mean[k]) / g.stddev[k];
+      }
+    }
+  }
+}
+
 }  // namespace
 
 extern "C" {
+
+// ImageNet record decode: per-batch pointers to u8 HWC payloads ->
+// random-resized-crop (train) or center-crop (eval) -> bilinear resize to
+// out_size -> optional flip -> per-channel normalize -> f32 NHWC out.
+void dlcfn_crop_resize_norm(const uint64_t* src_ptrs, int src_h, int src_w,
+                            float* out, int batch, int out_size,
+                            uint64_t seed, int augment, const float* mean,
+                            const float* stddev, int nthreads) {
+  CropCtx ctx{src_ptrs, src_h, src_w, out, out_size, seed,
+              augment != 0, mean, stddev};
+  parallel_for(batch, nthreads, crop_resize_one, &ctx);
+}
 
 // Gather src[idx[b]] for b in [0, batch) into out, optionally applying
 // random reflect-pad crop + horizontal flip (the CIFAR recipe).
@@ -139,6 +249,6 @@ void dlcfn_gather_rows_i32(const int32_t* src, const int32_t* idx,
   }, &c);
 }
 
-int dlcfn_version() { return 1; }
+int dlcfn_version() { return 2; }
 
 }  // extern "C"

@@ -10,10 +10,12 @@ SURVEY.md §3.3 "cuDNN / framework kernels"). Design:
   sequence (flash attention). Grid is (batch, heads, Q blocks); K/V live in
   VMEM whole (fine to ~16k tokens at d=64; long-context beyond that is the
   ring-attention path in ring_attention.py).
-- ``fused_attention``: public entry — dispatches to the kernel on TPU,
-  reference elsewhere; custom VJP recomputes the backward through the
-  reference implementation (flash-style recompute: nothing but the output
-  is saved, trading FLOPs for HBM exactly like jax.checkpoint).
+- ``_flash_backward``: FlashAttention-2-style blocked dq/dk/dv kernels —
+  the forward saves only O and the per-row logsumexp, the backward
+  recomputes P per block, so training memory is O(S) too (bias-free path).
+- ``fused_attention``: public entry — dispatches to the kernels on TPU,
+  reference elsewhere. With a bias, the backward falls back to the
+  reference VJP (a trainable bias's cotangent is [Sq,Sk]-shaped anyway).
 
 Shapes: q [B, H, Sq, D]; k/v [B, H, Sk, D]; optional additive bias
 broadcastable to [B, H, Sq, Sk] (use -inf for padding); returns [B, H, Sq, D].
@@ -72,8 +74,9 @@ def attention_reference(
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, causal: bool,
-                  sm_scale: float, block_k: int, seq_k: int, seq_q: int):
+def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
+                  causal: bool, sm_scale: float, block_k: int, seq_k: int,
+                  seq_q: int):
     """One (batch, head, q-block) program: online softmax over KV blocks.
 
     ``seq_q``/``seq_k`` are the TRUE (unpadded) lengths — the causal
@@ -142,6 +145,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, causal: bool,
     # -1e30-bias "masked" rows still have l > 0 and softmax normally).
     out = acc / jnp.maximum(l, 1e-30)
     o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
+    if lse_ref is not None:
+        # Per-row logsumexp of the SCALED logits — the statistic the flash
+        # backward needs to rebuild P without a second online softmax.
+        # Rows that saw nothing (padded tail) get +LARGE so the backward's
+        # exp(s - lse) underflows to exactly 0 for them.
+        lse = jnp.where(l[:, 0] > 0,
+                        m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-37)),
+                        -_NEG_INF)
+        lse_ref[0, 0, :] = lse.astype(jnp.float32)
 
 
 def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
@@ -154,7 +166,8 @@ def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
     return jnp.pad(x, widths)
 
 
-def _flash_forward(q, k, v, bias, causal, sm_scale, interpret=False):
+def _flash_forward(q, k, v, bias, causal, sm_scale, interpret=False,
+                   return_stats=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -214,24 +227,232 @@ def _flash_forward(q, k, v, bias, causal, sm_scale, interpret=False):
             lambda ib, ih, iq: (ib if bb > 1 else 0, ih if bh > 1 else 0,
                                 iq if bq > 1 else 0, 0)))
         args.append(bias)
-        kernel = functools.partial(_flash_kernel, **kernel_kw)
-    else:
-        def kernel(q_ref, k_ref, v_ref, o_ref):
-            _flash_kernel(q_ref, k_ref, v_ref, None, o_ref, **kernel_kw)
 
-    out = pl.pallas_call(
+        def kernel(q_ref, k_ref, v_ref, b_ref, o_ref, *maybe_lse):
+            _flash_kernel(q_ref, k_ref, v_ref, b_ref, o_ref,
+                          maybe_lse[0] if maybe_lse else None, **kernel_kw)
+    else:
+        def kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse):
+            _flash_kernel(q_ref, k_ref, v_ref, None, o_ref,
+                          maybe_lse[0] if maybe_lse else None, **kernel_kw)
+
+    out_specs = pl.BlockSpec((1, 1, block_q, d),
+                             lambda ib, ih, iq: (ib, ih, iq, 0))
+    out_shape = jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype)
+    if return_stats:
+        out_specs = [out_specs,
+                     pl.BlockSpec((1, 1, block_q),
+                                  lambda ib, ih, iq: (ib, ih, iq))]
+        out_shape = [out_shape,
+                     jax.ShapeDtypeStruct((b, h, sq_p), jnp.float32)]
+
+    result = pl.pallas_call(
         kernel,
         grid=(b, h, sq_p // block_q),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda ib, ih, iq: (ib, ih, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ) if not interpret else None,
         interpret=interpret,
     )(*args)
-    return out[:, :, :sq, :]
+    if return_stats:
+        out, lse = result
+        return out[:, :, :sq, :], lse[:, :, :sq]
+    return result[:, :, :sq, :]
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash kernels (backward)
+#
+# FlashAttention-2-style: the forward saves only O and the per-row
+# logsumexp; the backward recomputes P block-by-block from (q, k, lse) — so
+# no [Sq,Sk] tensor ever reaches HBM in training either. Two kernels:
+# dK/dV (grid over KV blocks, inner loop over Q blocks) and dQ (grid over Q
+# blocks, inner loop over KV blocks). delta = rowsum(dO * O) is a cheap
+# jnp precompute.
+#
+# Derivation (S = scale·QKᵀ, P = softmax(S), O = PV):
+#   dV = Pᵀ dO
+#   dP = dO Vᵀ ;  dS = P ∘ (dP - delta)
+#   dQ = scale · dS K ;  dK = scale · dSᵀ Q
+# ---------------------------------------------------------------------------
+
+
+def _bwd_mask(s, iq_block, ik_block, block_q, block_k, causal, seq_q, seq_k):
+    """Recreate the forward's masking (true-length causal diagonal + padded
+    KV columns) on one [block_q, block_k] score tile."""
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+        + ik_block * block_k
+    live = k_pos < seq_k
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+            + iq_block * block_q + (seq_k - seq_q)
+        live = live & (k_pos <= q_pos)
+    return jnp.where(live, s, _NEG_INF)
+
+
+def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, *, causal, sm_scale, block_q,
+                           seq_q, seq_k):
+    from jax.experimental import pallas as pl
+
+    ik = pl.program_id(2)
+    block_k = k_ref.shape[-2]
+    d = q_ref.shape[-1]
+    num_qb = q_ref.shape[-2] // block_q
+
+    k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
+    v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
+
+    if causal:
+        # First q block whose last row reaches this kv block's first column.
+        first_live = (ik * block_k - (seq_k - seq_q)) // block_q
+        qb_lo = jnp.maximum(first_live, 0)
+    else:
+        qb_lo = 0
+
+    def body(qi, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, 0, pl.ds(qi * block_q, block_q), :] \
+            .astype(jnp.float32)
+        do_blk = do_ref[0, 0, pl.ds(qi * block_q, block_q), :] \
+            .astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q_blk, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        s = _bwd_mask(s, qi, ik, block_q, block_k, causal, seq_q, seq_k)
+        p = jnp.exp(s - lse[:, None])  # [bq, bk]; 0 for masked/padded rows
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do_blk, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_acc = dk_acc + sm_scale * jax.lax.dot_general(
+            ds, q_blk, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    init = (jnp.zeros((block_k, d), jnp.float32),
+            jnp.zeros((block_k, d), jnp.float32))
+    dk, dv = jax.lax.fori_loop(qb_lo, num_qb, body, init)
+    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, causal, sm_scale, block_k, seq_q,
+                         seq_k):
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(2)
+    block_q = q_ref.shape[-2]
+    d = q_ref.shape[-1]
+    num_kb = k_ref.shape[-2] // block_k
+
+    q_blk = q_ref[0, 0, :, :].astype(jnp.float32)
+    do_blk = do_ref[0, 0, :, :].astype(jnp.float32)
+    lse = lse_ref[0, 0, :]
+    delta = delta_ref[0, 0, :]
+
+    if causal:
+        q_end = (iq + 1) * block_q + (seq_k - seq_q)
+        num_kb_live = jnp.minimum((q_end + block_k - 1) // block_k, num_kb)
+    else:
+        num_kb_live = num_kb
+
+    def body(kb, dq_acc):
+        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :] \
+            .astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :] \
+            .astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q_blk, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        s = _bwd_mask(s, iq, kb, block_q, block_k, causal, seq_q, seq_k)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq_acc + sm_scale * jax.lax.dot_general(
+            ds, k_blk, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_kb_live, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, interpret):
+    """dq, dk, dv via the blocked kernels (bias-free path)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[-2]
+    block_q = min(_BLOCK_Q, max(8, sq))
+    block_k = min(_BLOCK_K, max(8, sk))
+
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    qp = _pad_to(q, 2, block_q)
+    dop = _pad_to(g.astype(q.dtype), 2, block_q)
+    kp = _pad_to(k, 2, block_k)
+    vp = _pad_to(v, 2, block_k)
+    # Padded q rows: lse=+LARGE makes exp(s - lse) underflow to 0, delta=0.
+    lse_p = _pad_to(lse, 2, block_q)
+    if lse_p.shape[-1] != sq:
+        pad_rows = jnp.arange(lse_p.shape[-1]) >= sq
+        lse_p = jnp.where(pad_rows[None, None, :], -_NEG_INF, lse_p)
+    delta_p = _pad_to(delta, 2, block_q)
+    sq_p, sk_p = qp.shape[2], kp.shape[2]
+
+    common = dict(causal=causal, sm_scale=sm_scale, seq_q=sq, seq_k=sk)
+    q_spec = pl.BlockSpec((1, 1, sq_p, d), lambda ib, ih, i: (ib, ih, 0, 0))
+    row_spec = pl.BlockSpec((1, 1, sq_p), lambda ib, ih, i: (ib, ih, 0))
+    kv_blk_spec = pl.BlockSpec((1, 1, block_k, d),
+                               lambda ib, ih, i: (ib, ih, i, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkdv_kernel, block_q=block_q, **common),
+        grid=(b, h, sk_p // block_k),
+        in_specs=[q_spec, kv_blk_spec, kv_blk_spec, q_spec, row_spec,
+                  row_spec],
+        out_specs=[kv_blk_spec, kv_blk_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sk_p, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk_p, d), v.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ) if not interpret else None,
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse_p, delta_p)
+
+    q_blk_spec = pl.BlockSpec((1, 1, block_q, d),
+                              lambda ib, ih, i: (ib, ih, i, 0))
+    row_blk_spec = pl.BlockSpec((1, 1, block_q),
+                                lambda ib, ih, i: (ib, ih, i))
+    kv_spec = pl.BlockSpec((1, 1, sk_p, d), lambda ib, ih, i: (ib, ih, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k, **common),
+        grid=(b, h, sq_p // block_q),
+        in_specs=[q_blk_spec, kv_spec, kv_spec, q_blk_spec, row_blk_spec,
+                  row_blk_spec],
+        out_specs=q_blk_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ) if not interpret else None,
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse_p, delta_p)
+
+    return dq[:, :, :sq, :], dk[:, :, :sk, :], dv[:, :, :sk, :]
 
 
 # ---------------------------------------------------------------------------
@@ -248,16 +469,27 @@ def _fused_attention(q, k, v, bias, causal, sm_scale, use_pallas, interpret):
 
 
 def _fwd(q, k, v, bias, causal, sm_scale, use_pallas, interpret):
+    if use_pallas and bias is None:
+        # Full flash path: keep O + logsumexp so the backward kernels can
+        # rebuild P per block — O(S) residual memory in training too.
+        out, lse = _flash_forward(q, k, v, None, causal, sm_scale,
+                                  interpret=interpret, return_stats=True)
+        return out, (q, k, v, None, out, lse)
     out = _fused_attention(q, k, v, bias, causal, sm_scale, use_pallas,
                            interpret)
-    return out, (q, k, v, bias)
+    return out, (q, k, v, bias, None, None)
 
 
 def _bwd(causal, sm_scale, use_pallas, interpret, res, g):
-    # Flash-style backward: recompute attention (reference formulation —
-    # XLA fuses it) instead of saving softmax weights. Costs one extra
-    # forward of FLOPs, saves the [B,H,S,S] residual in HBM.
-    q, k, v, bias = res
+    q, k, v, bias, out, lse = res
+    if use_pallas and bias is None:
+        dq, dk, dv = _flash_backward(q, k, v, out, lse, g, causal,
+                                     sm_scale, interpret)
+        return dq, dk, dv, None
+    # Bias path (trainable biases must receive a cotangent, and dS would be
+    # a full [Sq,Sk] output anyway): recompute through the reference
+    # formulation — XLA fuses it. Costs O(S²) backward memory; bias-free
+    # training (the long-context path) never lands here.
     def f(q, k, v, bias):
         return attention_reference(q, k, v, bias, causal, sm_scale)
     _, vjp = jax.vjp(f, q, k, v, bias)

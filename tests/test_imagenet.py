@@ -1,0 +1,154 @@
+"""Real ImageNet ingestion tests: shard format round-trip, native/python
+augmentation parity (shared RNG contract), pipeline integration, converter
+from a JPEG tree, and the feed-rate microbench (SURVEY.md §8 hard-part #2)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu import dataio
+from deeplearning_cfn_tpu.config import DataConfig
+from deeplearning_cfn_tpu.data.imagenet import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    ShardedImageNetSource,
+    _crop_resize_norm_py,
+    load_imagenet_source,
+    measure_feed_rate,
+    prepare_imagenet,
+    write_shards,
+)
+from deeplearning_cfn_tpu.data.pipeline import build_pipeline
+
+
+def _fixture_shards(tmp_path, n=40, hw=48, num_classes=5, shard_records=16,
+                    seed=0):
+    rng = np.random.RandomState(seed)
+    images = rng.randint(0, 256, (n, hw, hw, 3), dtype=np.uint8)
+    labels = rng.randint(0, num_classes, n)
+    out = str(tmp_path / "train")
+    write_shards(out, images, labels, num_classes,
+                 shard_records=shard_records)
+    return out, images, labels
+
+
+def test_shard_roundtrip_multi_shard(tmp_path):
+    out, images, labels = _fixture_shards(tmp_path, n=40, shard_records=16)
+    with open(os.path.join(out, "index.json")) as fh:
+        index = json.load(fh)
+    assert len(index["shards"]) == 3  # 16 + 16 + 8
+    src = ShardedImageNetSource(out, train=False, image_size=48,
+                                native=False)
+    assert src.size == 40
+    np.testing.assert_array_equal(src._labels, labels.astype(np.int32))
+    # Center crop of a square source at source size == identity (up to the
+    # normalize transform).
+    batch = src.gather_seeded(np.asarray([7]), seed=123)
+    expect = (images[7].astype(np.float32) / 255.0 -
+              IMAGENET_MEAN) / IMAGENET_STD
+    np.testing.assert_allclose(batch["image"][0], expect, atol=1e-4)
+    assert batch["label"][0] == labels[7]
+
+
+def test_gather_deterministic_and_seed_sensitive(tmp_path):
+    out, _, _ = _fixture_shards(tmp_path)
+    src = ShardedImageNetSource(out, train=True, image_size=32,
+                                native=False)
+    idx = np.asarray([3, 17, 25])
+    a = src.gather_seeded(idx, seed=42)
+    b = src.gather_seeded(idx, seed=42)
+    c = src.gather_seeded(idx, seed=43)
+    np.testing.assert_array_equal(a["image"], b["image"])
+    assert np.abs(a["image"] - c["image"]).max() > 1e-3
+
+
+def test_eval_center_crop_seed_independent(tmp_path):
+    out, _, _ = _fixture_shards(tmp_path)
+    src = ShardedImageNetSource(out, train=False, image_size=32,
+                                native=False)
+    idx = np.asarray([1, 2])
+    np.testing.assert_array_equal(src.gather_seeded(idx, 1)["image"],
+                                  src.gather_seeded(idx, 2)["image"])
+
+
+@pytest.mark.skipif(not dataio.available(), reason="native dataio not built")
+@pytest.mark.parametrize("train", [False, True])
+def test_native_python_parity(tmp_path, train):
+    """The C++ kernel and the numpy fallback share one RNG contract — same
+    seed must give the same crops, flips, and pixels."""
+    out, _, _ = _fixture_shards(tmp_path)
+    native = ShardedImageNetSource(out, train=train, image_size=32,
+                                   native=True)
+    assert native._native, "native path did not activate"
+    fallback = ShardedImageNetSource(out, train=train, image_size=32,
+                                     native=False)
+    idx = np.asarray([0, 9, 21, 33])
+    a = native.gather_seeded(idx, seed=7)
+    b = fallback.gather_seeded(idx, seed=7)
+    np.testing.assert_allclose(a["image"], b["image"], atol=1e-4)
+    np.testing.assert_array_equal(a["label"], b["label"])
+
+
+def test_pipeline_integration_epoch_coverage(tmp_path):
+    """build_pipeline with a real shard dir: every example appears exactly
+    once per epoch across processes (per-host index sharding)."""
+    out, _, labels = _fixture_shards(tmp_path, n=40, num_classes=5)
+    cfg = DataConfig(name="imagenet", data_dir=str(tmp_path),
+                     image_size=32, prefetch=0, use_native_loader=False)
+    seen = []
+    for pidx in range(2):
+        pipe = build_pipeline(cfg, local_batch=4, num_classes=5, seed=0,
+                              train=True)
+        pipe.pidx, pipe.pcount = pidx, 2
+        for batch in pipe.one_epoch(0):
+            assert batch["image"].shape == (4, 32, 32, 3)
+            seen.extend(batch["label"].tolist())
+    assert len(seen) == 40
+    # Same multiset of labels as the fixture (global coverage, no dupes).
+    assert sorted(seen) == sorted(labels.tolist())
+
+
+def test_prepare_imagenet_from_jpeg_tree(tmp_path):
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+
+    src_dir = tmp_path / "jpeg"
+    rng = np.random.RandomState(0)
+    truth = {}
+    for cls in ["beagle", "abacus"]:  # sorted: abacus=0, beagle=1
+        (src_dir / cls).mkdir(parents=True)
+        for i in range(3):
+            arr = rng.randint(0, 256, (70, 90, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(src_dir / cls / f"img{i}.jpg",
+                                      quality=95)
+    out_dir = tmp_path / "shards" / "train"
+    index = prepare_imagenet(str(src_dir), str(out_dir), size=64,
+                             shard_records=4, log_every=0)
+    assert index["num_classes"] == 2
+    assert sum(s["num_records"] for s in index["shards"]) == 6
+    src = ShardedImageNetSource(str(out_dir), train=False, image_size=64,
+                                native=False)
+    assert src.size == 6
+    # Sorted class dirs define labels: abacus → 0 (first 3 records after
+    # label-major ordering), beagle → 1.
+    assert sorted(src._labels.tolist()) == [0, 0, 0, 1, 1, 1]
+
+
+def test_load_imagenet_source_requires_index(tmp_path):
+    cfg = DataConfig(name="imagenet", data_dir=str(tmp_path))
+    with pytest.raises(FileNotFoundError, match="index.json"):
+        load_imagenet_source(cfg, train=True)
+
+
+def test_feed_rate_microbench(tmp_path):
+    out, _, _ = _fixture_shards(tmp_path, n=64)
+    cfg = DataConfig(name="imagenet", data_dir=str(tmp_path),
+                     image_size=32, prefetch=2,
+                     use_native_loader=dataio.available())
+    pipe = build_pipeline(cfg, local_batch=8, num_classes=5, seed=0,
+                          train=True)
+    rate = measure_feed_rate(pipe, num_batches=6, warmup=1)
+    assert rate["images_per_sec"] > 0
+    assert rate["batch_size"] == 8.0

@@ -141,6 +141,81 @@ def test_flash_bias_with_kv_padding():
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk", [(64, 64), (100, 100), (64, 128),
+                                   (40, 72)])
+def test_flash_backward_matches_reference(causal, sq, sk):
+    """The blocked flash backward (dq/dk/dv kernels, interpret mode) must
+    agree with the reference VJP — including block-padded lengths where the
+    causal diagonal and padded rows/columns need masking in the recompute."""
+    q, k, v = _qkv(b=2, h=2, sq=sq, sk=sk, d=16, seed=8)
+    g = jnp.asarray(
+        np.random.RandomState(9).normal(0, 1, q.shape[:-1] + (16,)),
+        jnp.float32)
+
+    def loss_ref(q, k, v):
+        return jnp.vdot(attention_reference(q, k, v, causal=causal), g)
+
+    def loss_flash(q, k, v):
+        return jnp.vdot(
+            fused_attention(q, k, v, causal=causal,
+                            implementation="interpret"), g)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_ref, g_flash):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=5e-4, rtol=5e-4,
+            err_msg=f"d{name} mismatch")
+
+
+def test_flash_backward_bf16():
+    q, k, v = _qkv(sq=128, sk=128, d=32, dtype=jnp.bfloat16, seed=10)
+
+    def loss(impl):
+        def f(q, k, v):
+            return jnp.sum(fused_attention(q, k, v, causal=True,
+                                           implementation=impl) ** 2)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    for a, b in zip(loss("reference"), loss("interpret")):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   atol=5e-2, rtol=5e-2)
+
+
+def test_flash_backward_no_full_score_matrix():
+    """The point of the flash backward: no [Sq,Sk] intermediate anywhere in
+    the grad computation (walk the jaxpr, including pallas kernel bodies —
+    block tiles are fine, full S×S is not)."""
+    sq = sk = 512  # well above both block sizes
+    q, k, v = _qkv(b=1, h=1, sq=sq, sk=sk, d=16, seed=11)
+
+    def loss(q, k, v):
+        return jnp.sum(fused_attention(q, k, v, causal=True,
+                                       implementation="interpret") ** 2)
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+    offenders = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            for var in list(eqn.invars) + list(eqn.outvars):
+                aval = getattr(var, "aval", None)
+                shape = getattr(aval, "shape", ())
+                if len(shape) >= 2 and shape[-1] == sk and \
+                        shape[-2] == sq:
+                    offenders.append((eqn.primitive.name, shape))
+            for param in eqn.params.values():
+                inner = getattr(param, "jaxpr", param)
+                if hasattr(inner, "eqns"):
+                    walk(inner)
+
+    walk(jaxpr.jaxpr)
+    assert not offenders, f"full score-matrix tensors found: {offenders}"
+
+
 # -- ring attention ---------------------------------------------------------
 
 
