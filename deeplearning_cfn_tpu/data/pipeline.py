@@ -151,19 +151,29 @@ class DataPipeline:
             from .. import dataio
 
             self._native = dataio.available()
-        if not drop_remainder:
-            raise NotImplementedError("static shapes require drop_remainder")
+        # Static shapes always hold; drop_remainder=False keeps the tail by
+        # PADDING the final batch (repeated indices) and attaching an
+        # "eval_mask" key (1=real, 0=pad) every batch — exact-set evaluation
+        # ("75.9% top-1" means exactly 50 000 images, not 49 920).
+        self.drop_remainder = drop_remainder
+
+    @property
+    def _per_proc(self) -> int:
+        if self.drop_remainder:
+            return self.source.size // self.pcount
+        return -(-self.source.size // self.pcount)  # ceil
 
     @property
     def steps_per_epoch(self) -> int:
-        per_proc = self.source.size // self.pcount
-        return per_proc // self.local_batch
+        if self.drop_remainder:
+            return self._per_proc // self.local_batch
+        return -(-self._per_proc // self.local_batch)  # ceil
 
     def _epoch_indices(self, epoch: int) -> np.ndarray:
         idx = np.arange(self.source.size)
         if self.shuffle:
             np.random.RandomState(self.seed + epoch).shuffle(idx)
-        per_proc = self.source.size // self.pcount
+        per_proc = self._per_proc
         return idx[self.pidx * per_proc:(self.pidx + 1) * per_proc]
 
     def _gather_native(self, idx: np.ndarray, epoch: int, start: int
@@ -197,22 +207,34 @@ class DataPipeline:
                            self.steps_per_epoch * self.local_batch,
                            self.local_batch):
             batch_idx = idx[start:start + self.local_batch]
+            eval_mask = None
+            if not self.drop_remainder:
+                real = len(batch_idx)
+                eval_mask = np.zeros(self.local_batch, np.float32)
+                eval_mask[:real] = 1.0
+                if real < self.local_batch:
+                    # Pad with wrapped indices — shapes stay static, the
+                    # mask zeroes their metric contribution.
+                    pad = np.resize(idx[:max(real, 1)],
+                                    self.local_batch - real)
+                    batch_idx = np.concatenate([batch_idx, pad])
             if self._seeded:
                 # Seeded-gather sources (ImageNet shards) own their
                 # augmentation; the (seed, epoch, offset, process) mix makes
                 # it deterministic and resume-stable.
                 seed = ((self.seed + 1) * 7919 + epoch * 2654435761 +
                         start * 31 + self.pidx) & (2**64 - 1)
-                yield self.source.gather_seeded(
+                batch = self.source.gather_seeded(
                     np.asarray(batch_idx, np.int64), seed)
-                continue
-            if self._native:
-                yield self._gather_native(np.asarray(batch_idx, np.int32),
-                                          epoch, start)
-                continue
-            batch = self.source.gather(batch_idx)
-            if self.augment is not None:
-                batch = self.augment(batch, rng)
+            elif self._native:
+                batch = self._gather_native(np.asarray(batch_idx, np.int32),
+                                            epoch, start)
+            else:
+                batch = self.source.gather(batch_idx)
+                if self.augment is not None:
+                    batch = self.augment(batch, rng)
+            if eval_mask is not None:
+                batch = {**batch, "eval_mask": eval_mask}
             yield batch
 
     def epochs(self, start_epoch: int = 0, skip_batches: int = 0
@@ -269,7 +291,7 @@ def _thread_prefetch(it: Iterator[Batch], depth: int) -> Iterator[Batch]:
 
 def build_pipeline(
     cfg: DataConfig, local_batch: int, num_classes: int, seed: int = 0,
-    train: bool = True,
+    train: bool = True, drop_remainder: bool = True,
 ) -> DataPipeline:
     name = cfg.name
     want_real = bool(cfg.data_dir) and not cfg.synthetic
@@ -287,7 +309,7 @@ def build_pipeline(
             source, local_batch, seed=seed, shuffle=train,
             augment=augment_crop_flip if train else None,
             prefetch=cfg.prefetch, native=cfg.use_native_loader,
-            num_workers=cfg.num_workers,
+            num_workers=cfg.num_workers, drop_remainder=drop_remainder,
         )
 
     if name == "imagenet":
@@ -305,6 +327,7 @@ def build_pipeline(
             source, local_batch, seed=seed, shuffle=train,
             augment=None, prefetch=cfg.prefetch,
             native=cfg.use_native_loader, num_workers=cfg.num_workers,
+            drop_remainder=drop_remainder,
         )
 
     if name in ("wikipedia_mlm", "wmt_en_de", "coco"):
@@ -320,6 +343,7 @@ def build_pipeline(
         return DataPipeline(source, local_batch, seed=seed, shuffle=train,
                             prefetch=cfg.prefetch,
                             native=cfg.use_native_loader,
-                            num_workers=cfg.num_workers)
+                            num_workers=cfg.num_workers,
+                            drop_remainder=drop_remainder)
 
     raise KeyError(f"unknown dataset {name!r}")

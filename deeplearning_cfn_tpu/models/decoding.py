@@ -1,0 +1,121 @@
+"""Autoregressive decoding for the NMT workload: greedy + beam search.
+
+The reference's Sockeye shipped beam-search inference next to its trainer;
+the rebuild keeps the same acceptance metric (BLEU over decoded outputs —
+BASELINE.md tracking row 5), implemented TPU-first:
+
+- fixed-length ``lax.scan`` over target positions (no dynamic shapes; a
+  ``done`` mask freezes finished sequences), everything jit-compatible;
+- the encoder runs ONCE; each step re-applies only the decoder on the
+  growing prefix. The decoder recompute is O(T²) attention per sequence —
+  exact and simple; a KV-cache is a further constant-factor optimization,
+  not a correctness change (XLA fuses the recompute well at eval batch
+  sizes).
+
+Special ids follow data/text.py: 0=[PAD], 1=[BOS], 2=[EOS].
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+PAD_ID, BOS_ID, EOS_ID = 0, 1, 2
+
+
+def greedy_decode(model, variables, src_ids, src_mask, max_len: int
+                  ) -> jnp.ndarray:
+    """Argmax decoding → token ids [B, max_len] (PAD after EOS; the EOS
+    itself is kept so callers can see termination)."""
+    enc = model.apply(variables, src_ids, src_mask,
+                      method=type(model).encode)
+    b = src_ids.shape[0]
+    tokens = jnp.full((b, max_len + 1), PAD_ID, jnp.int32) \
+        .at[:, 0].set(BOS_ID)
+
+    def step(carry, t):
+        tokens, done = carry
+        logits = model.apply(variables, tokens[:, :-1], enc, src_mask,
+                             method=type(model).decode)
+        nxt = jnp.argmax(logits[:, t, :], axis=-1).astype(jnp.int32)
+        nxt = jnp.where(done, PAD_ID, nxt)
+        tokens = tokens.at[:, t + 1].set(nxt)
+        done = done | (nxt == EOS_ID)
+        return (tokens, done), None
+
+    (tokens, _), _ = jax.lax.scan(
+        step, (tokens, jnp.zeros((b,), bool)), jnp.arange(max_len))
+    return tokens[:, 1:]
+
+
+def beam_decode(model, variables, src_ids, src_mask, max_len: int,
+                beam_size: int = 4, length_penalty: float = 0.6
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Beam search → (tokens [B, max_len], scores [B]) for the best beam.
+
+    Standard log-prob accumulation with GNMT length normalization
+    ((5+|Y|)/6)^alpha. Finished beams only extend with PAD at zero cost, so
+    their scores freeze; selection at the end is over normalized scores.
+    """
+    b, s = src_ids.shape
+    w = beam_size
+    enc = model.apply(variables, src_ids, src_mask,
+                      method=type(model).encode)
+    # Expand to beams: [B*W, ...] with beam-major inner order.
+    rep = lambda x: jnp.repeat(x, w, axis=0)
+    enc_b, src_ids_b, src_mask_b = rep(enc), rep(src_ids), rep(src_mask)
+
+    tokens = jnp.full((b, w, max_len + 1), PAD_ID, jnp.int32) \
+        .at[:, :, 0].set(BOS_ID)
+    # All beams start identical: only beam 0 is live at t=0, or every beam
+    # would pick the same argmax forever.
+    scores = jnp.full((b, w), -1e9, jnp.float32).at[:, 0].set(0.0)
+    done = jnp.zeros((b, w), bool)
+    neg_big = -1e9
+
+    def step(carry, t):
+        tokens, scores, done = carry
+        flat = tokens.reshape(b * w, max_len + 1)
+        logits = model.apply(variables, flat[:, :-1], enc_b, src_mask_b,
+                             method=type(model).decode)
+        logp = jax.nn.log_softmax(logits[:, t, :].astype(jnp.float32))
+        v = logp.shape[-1]
+        logp = logp.reshape(b, w, v)
+        # Finished beams: only PAD continues, at no cost.
+        pad_only = jnp.full((v,), neg_big).at[PAD_ID].set(0.0)
+        logp = jnp.where(done[:, :, None], pad_only[None, None, :], logp)
+        cand = scores[:, :, None] + logp  # [B, W, V]
+        top_scores, top_flat = jax.lax.top_k(cand.reshape(b, w * v), w)
+        beam_idx = top_flat // v  # [B, W]
+        tok_idx = (top_flat % v).astype(jnp.int32)
+        tokens = jnp.take_along_axis(
+            tokens, beam_idx[:, :, None], axis=1)
+        tokens = tokens.at[:, :, t + 1].set(tok_idx)
+        done = jnp.take_along_axis(done, beam_idx, axis=1) | \
+            (tok_idx == EOS_ID)
+        return (tokens, top_scores, done), None
+
+    (tokens, scores, done), _ = jax.lax.scan(
+        step, (tokens, scores, done), jnp.arange(max_len))
+
+    lengths = jnp.sum((tokens[:, :, 1:] != PAD_ID).astype(jnp.float32), -1)
+    norm = ((5.0 + lengths) / 6.0) ** length_penalty
+    best = jnp.argmax(scores / jnp.maximum(norm, 1e-6), axis=1)
+    best_tokens = jnp.take_along_axis(
+        tokens[:, :, 1:], best[:, None, None], axis=1)[:, 0, :]
+    best_scores = jnp.take_along_axis(scores, best[:, None], axis=1)[:, 0]
+    return best_tokens, best_scores
+
+
+def strip_special(ids) -> list:
+    """Token-id row → python list up to (excluding) EOS, dropping PAD/BOS."""
+    out = []
+    for t in [int(x) for x in ids]:
+        if t == EOS_ID:
+            break
+        if t not in (PAD_ID, BOS_ID):
+            out.append(t)
+    return out

@@ -8,8 +8,13 @@ pre-LN blocks (stable without Sockeye's custom init), causal decoder
 self-attention and encoder-decoder cross-attention through the fused/flash
 kernel.
 
+Structured as ``encode``/``decode`` methods (setup-style) so inference runs
+the encoder once and re-applies only the decoder per step — the split
+models/decoding.py's greedy/beam search drives via ``apply(..., method=)``.
+
 Batch contract (see data/text.py): src_ids [B, S], src_mask [B, S],
 tgt_in_ids [B, T] (BOS-shifted), tgt_out_ids [B, T], tgt_mask [B, T].
+Special ids: 0=[PAD], 1=[BOS], 2=[EOS].
 """
 
 from __future__ import annotations
@@ -20,14 +25,54 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from . import register_model
-from .transformer import (
-    Embed,
-    TRANSFORMER_PARAM_RULES,
-    TransformerLayer,
-    padding_bias,
-)
+from .transformer import TRANSFORMER_PARAM_RULES, TransformerLayer, \
+    padding_bias
 
 PARAM_RULES = TRANSFORMER_PARAM_RULES
+
+
+class NmtEmbeddings(nn.Module):
+    """Shared token table (tied 3 ways: source, target, output projection)
+    plus separate learned source/target positions."""
+
+    vocab_size: int
+    hidden_size: int
+    max_len: int
+    dtype: Any = jnp.bfloat16
+    dropout_rate: float = 0.0
+
+    def setup(self):
+        self.token = nn.Embed(self.vocab_size, self.hidden_size,
+                              param_dtype=jnp.float32,
+                              embedding_init=nn.initializers.normal(0.02))
+        self.src_position = self.param(
+            "src_position", nn.initializers.normal(0.02),
+            (self.max_len, self.hidden_size), jnp.float32)
+        self.tgt_position = self.param(
+            "tgt_position", nn.initializers.normal(0.02),
+            (self.max_len, self.hidden_size), jnp.float32)
+        self.src_norm = nn.LayerNorm(dtype=self.dtype,
+                                     param_dtype=jnp.float32)
+        self.tgt_norm = nn.LayerNorm(dtype=self.dtype,
+                                     param_dtype=jnp.float32)
+        self.dropout = nn.Dropout(self.dropout_rate)
+
+    def embed_src(self, ids, deterministic=True):
+        x = self.token(ids) + self.src_position[None, :ids.shape[1], :]
+        x = self.src_norm(x.astype(self.dtype))
+        if self.dropout_rate > 0:
+            x = self.dropout(x, deterministic=deterministic)
+        return x
+
+    def embed_tgt(self, ids, deterministic=True):
+        y = self.token(ids) + self.tgt_position[None, :ids.shape[1], :]
+        y = self.tgt_norm(y.astype(self.dtype))
+        if self.dropout_rate > 0:
+            y = self.dropout(y, deterministic=deterministic)
+        return y
+
+    def logits(self, y):
+        return self.token.attend(y.astype(jnp.float32))
 
 
 class TransformerNMT(nn.Module):
@@ -41,45 +86,45 @@ class TransformerNMT(nn.Module):
     dropout_rate: float = 0.0
     attention_impl: str = "auto"
 
-    @nn.compact
-    def __call__(self, src_ids, src_mask, tgt_in_ids, train: bool = True):
+    def setup(self):
+        self.embed = NmtEmbeddings(
+            self.vocab_size, self.hidden_size, self.max_len, self.dtype,
+            self.dropout_rate)
+        layer = lambda cross: TransformerLayer(
+            self.num_heads, self.mlp_dim, self.dtype, self.dropout_rate,
+            prenorm=True, cross_attention=cross,
+            attention_impl=self.attention_impl)
+        self.enc = [layer(False) for _ in range(self.num_layers)]
+        self.enc_norm = nn.LayerNorm(dtype=self.dtype,
+                                     param_dtype=jnp.float32)
+        self.dec = [layer(True) for _ in range(self.num_layers)]
+        self.dec_norm = nn.LayerNorm(dtype=self.dtype,
+                                     param_dtype=jnp.float32)
+
+    def encode(self, src_ids, src_mask, train: bool = False):
         det = not train
-        # Shared source/target embedding (Sockeye ties all three matrices).
-        x, token_emb = Embed(
-            self.vocab_size, self.hidden_size, self.max_len,
-            dtype=self.dtype, dropout_rate=self.dropout_rate, name="embed",
-        )(src_ids, deterministic=det)
+        x = self.embed.embed_src(src_ids, deterministic=det)
         enc_bias = padding_bias(src_mask)
-        for i in range(self.num_layers):
-            x = TransformerLayer(
-                self.num_heads, self.mlp_dim, self.dtype, self.dropout_rate,
-                prenorm=True, attention_impl=self.attention_impl,
-                name=f"enc_{i}",
-            )(x, self_bias=enc_bias, deterministic=det)
-        enc = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
-                           name="enc_norm")(x)
+        for lyr in self.enc:
+            x = lyr(x, self_bias=enc_bias, deterministic=det)
+        return self.enc_norm(x)
 
-        # Decoder reuses the tied embedding table for target tokens.
-        y = token_emb(tgt_in_ids)
-        y = y + self.param(
-            "tgt_position", nn.initializers.normal(0.02),
-            (self.max_len, self.hidden_size), jnp.float32,
-        )[None, :tgt_in_ids.shape[1], :]
-        y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
-                         name="tgt_embed_norm")(y.astype(self.dtype))
-        for i in range(self.num_layers):
-            y = TransformerLayer(
-                self.num_heads, self.mlp_dim, self.dtype, self.dropout_rate,
-                prenorm=True, cross_attention=True,
-                attention_impl=self.attention_impl, name=f"dec_{i}",
-            )(y, enc=enc, cross_bias=enc_bias, causal=True,
-              deterministic=det)
-        y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
-                         name="dec_norm")(y)
+    def decode(self, tgt_in_ids, enc, src_mask, train: bool = False):
+        """Teacher-forced full-sequence decoder → logits [B, T, V].
+        Causal masking makes position t depend only on tgt_in_ids[:, :t+1],
+        which is what lets the searchers re-run it on growing prefixes."""
+        det = not train
+        y = self.embed.embed_tgt(tgt_in_ids, deterministic=det)
+        cross_bias = padding_bias(src_mask)
+        for lyr in self.dec:
+            y = lyr(y, enc=enc, cross_bias=cross_bias, causal=True,
+                    deterministic=det)
+        y = self.dec_norm(y)
+        return self.embed.logits(y)
 
-        # Tied output projection: logits = y · Eᵀ.
-        logits = token_emb.attend(y.astype(jnp.float32))
-        return logits
+    def __call__(self, src_ids, src_mask, tgt_in_ids, train: bool = True):
+        enc = self.encode(src_ids, src_mask, train=train)
+        return self.decode(tgt_in_ids, enc, src_mask, train=train)
 
 
 @register_model("transformer_nmt")

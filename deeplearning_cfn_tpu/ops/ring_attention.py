@@ -59,11 +59,25 @@ def ring_attention(
 
     q/k/v: this device's sequence shard, [B, H, S_local, D]; the global
     sequence is the concatenation over ``axis_name`` in axis-index order.
+
+    Differentiable with O(S_local) memory: a custom VJP re-rotates K/V in
+    the backward instead of saving every rotation as scan residuals (which
+    would grow per-device memory with the axis size — defeating sequence
+    parallelism at exactly the scale it targets).
     """
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _ring_attention(q, k, v, axis_name, causal, scale)
+
+
+def _ring_perm(axis_size):
+    return [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+
+def _ring_forward_impl(q, k, v, axis_name, causal, scale):
+    """Online-softmax ring pass; returns (out, lse[b,h,s_local,1])."""
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     q_off = my_idx * s_local
 
     def accumulate(carry, k_r, v_r, r):
@@ -80,7 +94,7 @@ def ring_attention(
         acc_new = acc_prev * alpha_prev + pv * alpha_cur
         return m_new, l_new, acc_new
 
-    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    perm = _ring_perm(axis_size)
 
     def step(carry, r):
         stats, kv = carry
@@ -103,8 +117,86 @@ def ring_attention(
         (stats, _), _ = jax.lax.scan(step, (stats, (k, v)),
                                      jnp.arange(1, axis_size))
     m, l, acc = stats
-    out = acc / jnp.maximum(l, 1e-30)
-    return out.astype(q.dtype)
+    out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    lse = m + jnp.log(jnp.maximum(l, 1e-37))
+    return out, lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_attention(q, k, v, axis_name, causal, scale):
+    return _ring_forward_impl(q, k, v, axis_name, causal, scale)[0]
+
+
+def _ring_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_forward_impl(q, k, v, axis_name, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(axis_name, causal, scale, res, g):
+    """Backward ring: q/do/lse/delta stay home; (k, v, dk, dv) rotate.
+
+    Each rotation recomputes P for one (local Q, visiting KV) block from
+    the saved logsumexp (flash-style), adds this q-shard's contribution to
+    the visiting block's dk/dv, and accumulates dq locally. After the full
+    ring plus one final rotation the dk/dv partials arrive back on their
+    home device — total memory stays O(S_local), independent of axis size.
+    """
+    q, k, v, out, lse = res
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    q_off = my_idx * s_local
+    perm = _ring_perm(axis_size)
+
+    do = g.astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1, keepdims=True)
+
+    def block_grads(k_r, v_r, r):
+        src = (my_idx - r) % axis_size
+        k_off = src * s_local
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_r.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = jnp.arange(s_local)[:, None] + q_off
+            k_pos = jnp.arange(s_local)[None, :] + k_off
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # [b,h,q,k]; 0 where masked
+        dv_c = jnp.einsum("bhqk,bhqd->bhkd", p, do,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do, v_r.astype(jnp.float32),
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_c = scale * jnp.einsum("bhqk,bhkd->bhqd", ds,
+                                  k_r.astype(jnp.float32),
+                                  preferred_element_type=jnp.float32)
+        dk_c = scale * jnp.einsum("bhqk,bhqd->bhkd", ds, qf,
+                                  preferred_element_type=jnp.float32)
+        return dq_c, dk_c, dv_c
+
+    # r = 0: own block, no comm.
+    dq, dk, dv = block_grads(k, v, 0)
+
+    def step(carry, r):
+        dq_acc, kvg = carry
+        k_r = jax.lax.ppermute(kvg[0], axis_name, perm)
+        v_r = jax.lax.ppermute(kvg[1], axis_name, perm)
+        dk_r = jax.lax.ppermute(kvg[2], axis_name, perm)
+        dv_r = jax.lax.ppermute(kvg[3], axis_name, perm)
+        dq_c, dk_c, dv_c = block_grads(k_r, v_r, r)
+        return (dq_acc + dq_c, (k_r, v_r, dk_r + dk_c, dv_r + dv_c)), None
+
+    if axis_size > 1:
+        (dq, (_, _, dk, dv)), _ = jax.lax.scan(
+            step, (dq, (k, v, dk, dv)), jnp.arange(1, axis_size))
+        # The visiting block is one final hop from home.
+        dk = jax.lax.ppermute(dk, axis_name, perm)
+        dv = jax.lax.ppermute(dv, axis_name, perm)
+
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_attention.defvjp(_ring_fwd, _ring_bwd)
 
 
 def ring_attention_sharded(
@@ -115,12 +207,13 @@ def ring_attention_sharded(
     axis_name: str = "data",
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    batch_axis: Optional[str] = None,
 ) -> jnp.ndarray:
     """Global-array wrapper: shards the sequence dim over ``axis_name`` and
-    runs the ring. Batch/head/feature dims stay replicated here — compose
-    with data-parallel sharding by calling ``ring_attention`` directly
-    inside your own shard_map with richer PartitionSpecs."""
-    spec = P(None, None, axis_name, None)
+    runs the ring; ``batch_axis`` additionally shards the batch dim
+    (composed data × sequence parallelism). For richer layouts call
+    ``ring_attention`` directly inside your own shard_map."""
+    spec = P(batch_axis, None, axis_name, None)
     fn = partial(ring_attention, axis_name=axis_name, causal=causal,
                  sm_scale=sm_scale)
     mapped = jax.shard_map(

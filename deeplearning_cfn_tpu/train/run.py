@@ -40,9 +40,12 @@ def run_experiment(
                                 cfg.model.num_classes, seed=cfg.train.seed,
                                 train=True)
     eval_batch = cfg.train.eval_batch or cfg.train.global_batch
+    # Tasks that weight metrics by eval_mask get the exact full eval set
+    # (padded tail); others keep the drop-remainder contract.
+    exact_eval = getattr(task, "exact_eval", False)
     eval_pipe = build_pipeline(cfg.data, local_batch_size(eval_batch, mesh),
                                cfg.model.num_classes, seed=cfg.train.seed,
-                               train=False)
+                               train=False, drop_remainder=not exact_eval)
 
     steps_per_epoch = max(train_pipe.steps_per_epoch, 1)
     total_steps = (cfg.train.steps if cfg.train.steps > 0

@@ -31,11 +31,23 @@ def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
 
 
+def example_mask(batch: Dict[str, jnp.ndarray], n: int) -> jnp.ndarray:
+    """Per-example validity [B]: the pipeline's eval-tail padding mask when
+    present (drop_remainder=False), else all-ones. Tasks weight every eval
+    metric by it so padded examples contribute exactly nothing — and the
+    trainer aggregates across batches by these weights, making metrics
+    exact over the full eval set."""
+    mask = batch.get("eval_mask")
+    return jnp.ones((n,), jnp.float32) if mask is None else mask
+
+
 class ClassificationTask:
     """Image classification (CIFAR ResNet-20, ImageNet ResNet-50).
 
     Batch contract: ``{"image": [B,H,W,C] float32, "label": [B] int32}``.
     """
+
+    exact_eval = True  # consumes eval_mask; gets the padded full eval set
 
     def __init__(self, cfg: ExperimentConfig):
         self.cfg = cfg
@@ -77,18 +89,22 @@ class ClassificationTask:
                 variables["batch_stats"] = batch_stats
             logits = self.model.apply(variables, batch["image"], train=False)
             new_stats = batch_stats
-        # Global-batch mean: with the batch dim sharded over 'data', XLA turns
-        # this mean into local-sum + psum over ICI — the Horovod allreduce.
-        loss = jnp.mean(
-            cross_entropy(logits, batch["label"],
-                          self.cfg.train.label_smoothing)
-        )
-        accuracy = jnp.mean(
-            (jnp.argmax(logits, axis=-1) == batch["label"]).astype(jnp.float32)
-        )
+        # Global-batch (masked) mean: with the batch dim sharded over
+        # 'data', XLA turns these sums into local-sum + psum over ICI — the
+        # Horovod allreduce.
+        mask = example_mask(batch, logits.shape[0])
+        denom = jnp.maximum(jnp.sum(mask), 1e-6)
+        ce = cross_entropy(logits, batch["label"],
+                           self.cfg.train.label_smoothing)
+        loss = jnp.sum(ce * mask) / denom
+        correct = (jnp.argmax(logits, axis=-1) == batch["label"]) \
+            .astype(jnp.float32)
+        accuracy = jnp.sum(correct * mask) / denom
         aux: Dict[str, jnp.ndarray] = {"accuracy": accuracy}
         if train:
             aux["batch_stats"] = new_stats
+        else:
+            aux["eval_weight"] = jnp.sum(mask)
         return loss, aux
 
 
@@ -99,6 +115,8 @@ class MlmTask:
     next-sentence cross-entropy — the standard BERT objective. Batch
     contract documented in data/text.py make_mlm_source.
     """
+
+    exact_eval = True
 
     def __init__(self, cfg: ExperimentConfig):
         self.cfg = cfg
@@ -128,27 +146,34 @@ class MlmTask:
         if train and self.remat:
             apply = jax.checkpoint(apply)
         out = apply(params, batch)
-        weights = batch["mlm_weights"]
+        mask = example_mask(batch, batch["input_ids"].shape[0])
+        weights = batch["mlm_weights"] * mask[:, None]
         mlm_ce = cross_entropy(out["mlm_logits"], batch["mlm_ids"])
         # Weighted global mean — masked slots carry no gradient, and the
         # normalizer is the global count, so DP psum stays correct.
-        mlm_loss = jnp.sum(mlm_ce * weights) / jnp.maximum(
-            jnp.sum(weights), 1e-6)
-        nsp_loss = jnp.mean(cross_entropy(out["nsp_logits"],
-                                          batch["nsp_label"]))
+        token_denom = jnp.maximum(jnp.sum(weights), 1e-6)
+        mlm_loss = jnp.sum(mlm_ce * weights) / token_denom
+        example_denom = jnp.maximum(jnp.sum(mask), 1e-6)
+        nsp_ce = cross_entropy(out["nsp_logits"], batch["nsp_label"])
+        nsp_loss = jnp.sum(nsp_ce * mask) / example_denom
         loss = mlm_loss + nsp_loss
         mlm_hits = (jnp.argmax(out["mlm_logits"], -1) == batch["mlm_ids"])
+        nsp_hits = (jnp.argmax(out["nsp_logits"], -1) == batch["nsp_label"]) \
+            .astype(jnp.float32)
         aux = {
             "mlm_loss": mlm_loss,
             "nsp_loss": nsp_loss,
-            "mlm_accuracy": jnp.sum(mlm_hits * weights) / jnp.maximum(
-                jnp.sum(weights), 1e-6),
-            "nsp_accuracy": jnp.mean(
-                (jnp.argmax(out["nsp_logits"], -1) == batch["nsp_label"])
-                .astype(jnp.float32)),
+            "mlm_accuracy": jnp.sum(mlm_hits * weights) / token_denom,
+            "nsp_accuracy": jnp.sum(nsp_hits * mask) / example_denom,
         }
         if train:
             aux["batch_stats"] = batch_stats
+        else:
+            # Per-metric weights: MLM metrics are token-weighted, NSP (and
+            # the combined loss) example-weighted.
+            aux["eval_weight"] = jnp.sum(mask)
+            aux["mlm_loss__weight"] = jnp.sum(weights)
+            aux["mlm_accuracy__weight"] = jnp.sum(weights)
         return loss, aux
 
 
@@ -158,6 +183,8 @@ class Seq2SeqTask:
     Per-token label-smoothed cross-entropy, masked to real target positions,
     normalized by the global token count (Sockeye's per-token loss).
     """
+
+    exact_eval = True
 
     def __init__(self, cfg: ExperimentConfig):
         self.cfg = cfg
@@ -185,7 +212,8 @@ class Seq2SeqTask:
         if train and self.remat:
             apply = jax.checkpoint(apply)
         logits = apply(params, batch)
-        mask = batch["tgt_mask"]
+        ex_mask = example_mask(batch, batch["src_ids"].shape[0])
+        mask = batch["tgt_mask"] * ex_mask[:, None]
         ce = cross_entropy(logits, batch["tgt_out_ids"],
                            self.cfg.train.label_smoothing)
         denom = jnp.maximum(jnp.sum(mask), 1e-6)
@@ -196,6 +224,9 @@ class Seq2SeqTask:
         }
         if train:
             aux["batch_stats"] = batch_stats
+        else:
+            # Token-weighted: Sockeye's per-token loss convention.
+            aux["eval_weight"] = jnp.sum(mask)
         return loss, aux
 
 

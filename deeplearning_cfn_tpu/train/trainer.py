@@ -229,16 +229,31 @@ class Trainer:
 
     def evaluate(self, state: TrainState, eval_iter: Iterator[Batch],
                  max_steps: int = 0) -> Dict[str, float]:
+        """Weighted cross-batch aggregation: each batch's metrics carry
+        their normalizer (``eval_weight``, or a per-metric
+        ``<name>__weight``), so the result is the exact full-set metric —
+        not a mean of batch means, which is biased whenever batches have
+        unequal effective weights (padded eval tails, per-token metrics)."""
         totals: Dict[str, float] = {}
-        count = 0
+        wsums: Dict[str, float] = {}
+        examples = 0.0
         eb = self.cfg.train.eval_batch or self.cfg.train.global_batch
         for i, batch in enumerate(eval_iter):
             if max_steps and i >= max_steps:
                 break
             dev_batch = self.device_batch(batch, global_batch=eb)
-            metrics = jax.device_get(self.eval_step(state, dev_batch))
+            metrics = {k: float(v) for k, v in
+                       jax.device_get(self.eval_step(state, dev_batch))
+                       .items()}
+            default_w = metrics.pop("eval_weight", float(eb))
+            examples += default_w
             for k, v in metrics.items():
-                totals[k] = totals.get(k, 0.0) + float(v)
-            count += 1
-        return {k: v / max(count, 1) for k, v in totals.items()}
+                if k.endswith("__weight"):
+                    continue
+                w = metrics.get(f"{k}__weight", default_w)
+                totals[k] = totals.get(k, 0.0) + v * w
+                wsums[k] = wsums.get(k, 0.0) + w
+        out = {k: totals[k] / max(wsums[k], 1e-9) for k in totals}
+        out["examples"] = examples
+        return out
 

@@ -121,3 +121,38 @@ def test_mid_epoch_resume_skips_consumed_batches():
     resumed_it = pipe.epochs(start_epoch=0, skip_batches=3)
     resumed_first = next(resumed_it)["x"].tolist()
     assert resumed_first == full[3]
+
+
+def test_padded_eval_tail_single_process():
+    """drop_remainder=False: every example appears once; the final batch is
+    padded with eval_mask zeros (exact-set evaluation)."""
+    src = ArraySource({"x": np.arange(70, dtype=np.float32)})
+    pipe = DataPipeline(src, local_batch=32, prefetch=0, shuffle=False,
+                        drop_remainder=False, process_index=0,
+                        process_count=1)
+    batches = list(pipe.one_epoch(0))
+    assert pipe.steps_per_epoch == 3 and len(batches) == 3
+    masks = np.concatenate([b["eval_mask"] for b in batches])
+    assert masks.sum() == 70
+    xs = np.concatenate([b["x"] for b in batches])
+    assert sorted(xs[masks > 0].tolist()) == list(range(70))
+    # Shapes stay static even on the padded tail.
+    assert all(b["x"].shape == (32,) for b in batches)
+
+
+def test_padded_eval_tail_multi_process():
+    """Ceil chunking: processes cover the whole set between them and run
+    the SAME number of steps (collective lockstep), padding where short."""
+    src = ArraySource({"x": np.arange(70, dtype=np.float32)})
+    seen = []
+    steps = []
+    for pidx in range(3):
+        pipe = DataPipeline(src, local_batch=16, prefetch=0, shuffle=False,
+                            drop_remainder=False, process_index=pidx,
+                            process_count=3)
+        batches = list(pipe.one_epoch(0))
+        steps.append(len(batches))
+        for b in batches:
+            seen.extend(b["x"][b["eval_mask"] > 0].tolist())
+    assert len(set(steps)) == 1  # lockstep
+    assert sorted(seen) == list(range(70))

@@ -171,3 +171,39 @@ def test_remat_flag_trains(tmp_workdir, devices):
     apply_overrides(cfg, ["train.remat=true"])
     final = run_experiment(cfg)
     assert np.isfinite(final["loss"])
+
+
+def test_exact_eval_counts_every_example(tmp_workdir, devices):
+    """The eval set does not divide the eval batch (70 % 32 != 0): with the
+    padded-tail pipeline the trainer must still count ALL 70 examples, and
+    the weighted accuracy must equal the directly-computed full-set value
+    — not a mean of unequal batch means."""
+    cfg = _tiny_cfg(tmp_workdir)
+    apply_overrides(cfg, ["data.num_eval_examples=70"])
+    mesh = build_mesh(cfg.mesh)
+    task = build_task(cfg)
+    sched = build_schedule(cfg.schedule, 4, cfg.train.global_batch, 8)
+    tx = build_optimizer(cfg.optimizer, sched)
+    state = create_train_state(jax.random.PRNGKey(0), task.init, tx, mesh)
+    trainer = Trainer(cfg, task.loss_fn, tx, mesh=mesh)
+    from deeplearning_cfn_tpu.data import build_pipeline
+
+    eval_pipe = build_pipeline(cfg.data, cfg.train.global_batch, 10,
+                               train=False, drop_remainder=False)
+    metrics = trainer.evaluate(state, eval_pipe.one_epoch())
+    assert metrics["examples"] == 70.0
+
+    # Oracle: accuracy over the full set computed directly, one example at
+    # a time — batch-size independent.
+    correct = 0
+    variables = {"params": state.params}
+    if state.batch_stats:
+        variables["batch_stats"] = state.batch_stats
+    for batch in eval_pipe.one_epoch():
+        logits = task.model.apply(variables, jnp.asarray(batch["image"]),
+                                  train=False)
+        pred = np.argmax(np.asarray(logits), -1)
+        m = batch["eval_mask"] > 0
+        correct += int((pred[m] == batch["label"][m]).sum())
+    np.testing.assert_allclose(metrics["accuracy"], correct / 70.0,
+                               atol=1e-6)
