@@ -109,6 +109,25 @@ class DataConfig:
 
 
 @dataclasses.dataclass
+class EvalConfig:
+    """Final acceptance-metric evaluation — the reference workloads' own
+    yardsticks (SURVEY.md §3.1): corpus BLEU over beam-decoded outputs for
+    the Sockeye NMT workload, COCO-style mAP for Mask R-CNN. Runs once at
+    the end of ``run_experiment`` and lands in metrics.jsonl as
+    ``final_eval_bleu`` / ``final_eval_map``."""
+
+    enabled: bool = True
+    # NMT decoding (models/decoding.py).
+    beam_size: int = 4  # 1 = greedy
+    length_penalty: float = 0.6
+    max_decode_len: int = 0  # 0 = data.seq_len
+    # Detection inference (train/detection_task.py post-processing).
+    detect_topk: int = 100  # fixed detections per image (COCO maxDets)
+    detect_score_threshold: float = 0.05
+    detect_nms_iou: float = 0.5
+
+
+@dataclasses.dataclass
 class CheckpointConfig:
     directory: str = ""  # empty → <workdir>/ckpt
     every_steps: int = 0  # 0 = per-epoch
@@ -149,6 +168,7 @@ class ExperimentConfig:
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
     schedule: ScheduleConfig = dataclasses.field(default_factory=ScheduleConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    eval: EvalConfig = dataclasses.field(default_factory=EvalConfig)
     checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
     stack: StackConfig = dataclasses.field(default_factory=StackConfig)
 

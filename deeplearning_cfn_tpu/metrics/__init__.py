@@ -1,1 +1,3 @@
+from .bleu import corpus_bleu  # noqa: F401
+from .coco_map import DetectionAccumulator  # noqa: F401
 from .jsonl import MetricsWriter, read_metrics  # noqa: F401

@@ -24,10 +24,12 @@ from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from ..config import ExperimentConfig
 from ..models import build_model
+from .task import eval_params, example_mask, realized_eval_batches
 from ..ops.detection import (
     decode_boxes,
     encode_boxes,
@@ -58,6 +60,8 @@ def _mean_where(values, weights):
 
 class DetectionTask:
     """Loss-producing task for maskrcnn_* models (cfg preset maskrcnn_coco)."""
+
+    exact_eval = True  # consume the padded full eval set (COCO protocol)
 
     def __init__(self, cfg: ExperimentConfig):
         self.cfg = cfg
@@ -118,16 +122,10 @@ class DetectionTask:
         return cls_t, box_t
 
     def _proposals(self, rpn_logits, rpn_deltas, gt_boxes, gt_valid):
-        """→ boxes [P,4], valid [P] with P = post_nms_topk + max_boxes."""
-        scores = jax.nn.sigmoid(rpn_logits)
-        boxes = decode_boxes(rpn_deltas, self.anchors,
-                             clip_hw=(self.image_size, self.image_size))
-        k = min(self.pre_nms_topk, scores.shape[0])
-        top_scores, top_idx = jax.lax.top_k(scores, k)
-        top_boxes = boxes[top_idx]
-        keep_idx, keep = nms_static(top_boxes, top_scores, self.nms_iou,
-                                    min(self.post_nms_topk, k))
-        props = top_boxes[keep_idx]
+        """→ boxes [P,4], valid [P] with P = post_nms_topk + max_boxes:
+        the inference proposals plus appended GT boxes (the standard
+        train-time stabilizer)."""
+        props, keep = self._proposals_infer(rpn_logits, rpn_deltas)
         props = jnp.concatenate([props, gt_boxes], axis=0)
         valid = jnp.concatenate([keep, gt_valid > 0], axis=0)
         return jax.lax.stop_gradient(props), valid
@@ -157,6 +155,117 @@ class DetectionTask:
         xx = jnp.broadcast_to(xs[None, :], (MASK_SIZE, MASK_SIZE))
         return _bilinear_sample(gt_mask[:, :, None], yy, xx)[..., 0]
 
+    # -- inference ----------------------------------------------------------
+
+    def _proposals_infer(self, rpn_logits, rpn_deltas):
+        """Inference proposals: decode → top-K → NMS (no GT append)."""
+        scores = jax.nn.sigmoid(rpn_logits)
+        boxes = decode_boxes(rpn_deltas, self.anchors,
+                             clip_hw=(self.image_size, self.image_size))
+        k = min(self.pre_nms_topk, scores.shape[0])
+        top_scores, top_idx = jax.lax.top_k(scores, k)
+        top_boxes = boxes[top_idx]
+        keep_idx, keep = nms_static(top_boxes, top_scores, self.nms_iou,
+                                    min(self.post_nms_topk, k))
+        return top_boxes[keep_idx], keep
+
+    def _detect_one(self, cls_probs, box_deltas, props, valid,
+                    topk: int, score_thr: float, nms_iou: float):
+        """Per-image post-processing: class-specific box decode, per-class
+        NMS, global top-K → fixed-K (boxes [K,4], scores [K], classes [K],
+        class 0 = empty slot). All static shapes — the per-class loop is a
+        vmap over the (C-1)×P score/delta planes."""
+        num_classes = cls_probs.shape[-1]
+        s = self.image_size
+        p = cls_probs.shape[0]
+        k_per_class = min(topk, p)
+
+        def per_class(c_probs, c_deltas):
+            boxes_c = decode_boxes(c_deltas, props, clip_hw=(s, s))
+            ok = valid & (c_probs >= score_thr)
+            idx, keep = nms_static(boxes_c, c_probs, nms_iou, k_per_class,
+                                   valid=ok)
+            return boxes_c[idx], jnp.where(keep, c_probs[idx], 0.0)
+
+        fg_probs = jnp.moveaxis(cls_probs[:, 1:], 1, 0)      # [C-1, P]
+        fg_deltas = jnp.moveaxis(box_deltas[:, 1:, :], 1, 0)  # [C-1, P, 4]
+        boxes_pc, scores_pc = jax.vmap(per_class)(fg_probs, fg_deltas)
+        classes_pc = jnp.broadcast_to(
+            jnp.arange(1, num_classes, dtype=jnp.int32)[:, None],
+            scores_pc.shape)
+        flat_boxes = boxes_pc.reshape(-1, 4)
+        flat_scores = scores_pc.reshape(-1)
+        flat_classes = classes_pc.reshape(-1)
+        k_out = min(topk, flat_scores.shape[0])
+        top_scores, top_i = jax.lax.top_k(flat_scores, k_out)
+        out_boxes = flat_boxes[top_i]
+        out_classes = jnp.where(top_scores > 0.0, flat_classes[top_i], 0)
+        return out_boxes, top_scores, out_classes
+
+    def predict_fn(self, topk: int, score_thr: float, nms_iou: float):
+        """Build the jittable full inference step:
+        (variables, images) → {boxes [B,K,4], scores, classes, masks}."""
+
+        def infer(mdl, images):
+            out = mdl(images, train=False)
+            props, valid = jax.vmap(self._proposals_infer)(
+                out["rpn_logits"], out["rpn_deltas"])
+            align = functools.partial(
+                multilevel_roi_align, out_size=ROI_SIZE, strides=STRIDES)
+            rois = jax.vmap(lambda f, b: align(f, b))(out["pyramid"], props)
+            cls_logits, box_deltas = mdl.run_box_head(rois)
+            cls_probs = jax.nn.softmax(cls_logits.astype(jnp.float32), -1)
+            boxes, scores, classes = jax.vmap(
+                lambda cp, bd, pr, va: self._detect_one(
+                    cp, bd, pr, va, topk, score_thr, nms_iou)
+            )(cls_probs, box_deltas, props, valid)
+            m_rois = jax.vmap(lambda f, b: multilevel_roi_align(
+                f, b, out_size=MASK_ROI_SIZE, strides=STRIDES))(
+                    out["pyramid"], boxes)
+            mask_logits = mdl.run_mask_head(m_rois)
+            m = jnp.take_along_axis(
+                mask_logits, classes[:, :, None, None, None], axis=4)[..., 0]
+            masks = jax.nn.sigmoid(m.astype(jnp.float32))
+            return {"boxes": boxes, "scores": scores, "classes": classes,
+                    "masks": masks}
+
+        def predict(variables, images):
+            return self.model.apply(variables, images, method=infer)
+
+        return jax.jit(predict)
+
+    def final_eval(self, state, eval_iter_fn, trainer):
+        """COCO-style box/mask mAP over the eval set — the TensorPack Mask
+        R-CNN workload's acceptance metric (BASELINE.md row 5). Runs the
+        static-shape inference path per batch and streams per-image results
+        into metrics/coco_map.DetectionAccumulator."""
+        from ..metrics.coco_map import DetectionAccumulator
+
+        ev = self.cfg.eval
+        if not ev.enabled:
+            return {}
+        variables = {"params": eval_params(state)}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        predict = self.predict_fn(ev.detect_topk, ev.detect_score_threshold,
+                                  ev.detect_nms_iou)
+        eb = self.cfg.train.eval_batch or self.cfg.train.global_batch
+        acc = DetectionAccumulator()
+        s = self.image_size
+        for det, gt, emask in realized_eval_batches(
+                trainer, eb, eval_iter_fn,
+                lambda dev: predict(variables, dev["image"]),
+                batch_keys=("boxes", "labels", "masks")):
+            for i in range(det["boxes"].shape[0]):
+                if emask is not None and emask[i] == 0:
+                    continue
+                acc.add_image(
+                    det["boxes"][i], det["scores"][i], det["classes"][i],
+                    gt["boxes"][i], gt["labels"][i],
+                    pred_masks=det["masks"][i], gt_masks=gt["masks"][i],
+                    image_hw=(s, s))
+        return acc.compute(with_masks=True)
+
     # -- loss ---------------------------------------------------------------
 
     def loss_fn(self, params, batch_stats, batch, rng, train
@@ -169,14 +278,18 @@ class DetectionTask:
             gt_boxes = batch["boxes"].astype(jnp.float32)
             gt_labels = batch["labels"]
             gt_valid = (gt_labels > 0).astype(jnp.float32)
+            # Padded eval-tail examples (exact_eval contract) carry zero
+            # weight in every loss/metric; matching stays per-image so
+            # zero-weight images never affect real ones.
+            ex = example_mask(batch, images.shape[0])
             out = mdl(images, train=train)
 
             # RPN losses (vmapped target assignment, dense weighting).
             cls_t, box_t = jax.vmap(self._rpn_targets)(gt_boxes, gt_valid)
             rpn_bce = optax.sigmoid_binary_cross_entropy(
                 out["rpn_logits"], jnp.maximum(cls_t, 0.0))
-            pos_w = (cls_t == 1.0).astype(jnp.float32)
-            neg_w = (cls_t == 0.0).astype(jnp.float32)
+            pos_w = (cls_t == 1.0).astype(jnp.float32) * ex[:, None]
+            neg_w = (cls_t == 0.0).astype(jnp.float32) * ex[:, None]
             rpn_cls_loss = _mean_where(rpn_bce, pos_w) + \
                 _mean_where(rpn_bce, neg_w)
             rpn_box_loss = _mean_where(
@@ -195,8 +308,8 @@ class DetectionTask:
             rois = jax.vmap(lambda f, b: align(f, b))(
                 out["pyramid"], props)
             cls_logits, box_deltas = mdl.run_box_head(rois)
-            valid_f = valid.astype(jnp.float32)
-            pos_f = roi_pos.astype(jnp.float32)
+            valid_f = valid.astype(jnp.float32) * ex[:, None]
+            pos_f = roi_pos.astype(jnp.float32) * ex[:, None]
             ce = optax.softmax_cross_entropy_with_integer_labels(
                 cls_logits, roi_cls_t)
             roi_cls_loss = _mean_where(ce, valid_f)
@@ -236,7 +349,7 @@ class DetectionTask:
             prop_gt_iou = jax.vmap(iou_matrix)(props, gt_boxes)
             best = jnp.max(prop_gt_iou * valid_f[:, :, None], axis=1)
             recall = _mean_where((best >= 0.5).astype(jnp.float32),
-                                 gt_valid)
+                                 gt_valid * ex[:, None])
 
             losses = {
                 "rpn_cls_loss": rpn_cls_loss,
@@ -247,6 +360,8 @@ class DetectionTask:
             }
             total = sum(losses.values())
             metrics = {**losses, "proposal_recall": recall}
+            if not train:
+                metrics["eval_weight"] = jnp.sum(ex)
             return total, metrics
 
         variables = {"params": params}

@@ -109,6 +109,13 @@ def run_experiment(
     manager.wait()
 
     final = trainer.evaluate(state, eval_pipe.one_epoch())
+    # Workload acceptance metrics beyond the loss-based eval: tasks that
+    # define final_eval run the reference's own yardstick (BLEU for NMT,
+    # COCO mAP for detection) over the eval set once, at the end.
+    task_final_eval = getattr(task, "final_eval", None)
+    if task_final_eval is not None and cfg.eval.enabled:
+        final.update(task_final_eval(
+            state, lambda: eval_pipe.one_epoch(), trainer))
     writer.write({"step": int(state.step),
                   **{f"final_eval_{k}": v for k, v in final.items()}})
     writer.close()

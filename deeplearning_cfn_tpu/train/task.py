@@ -12,6 +12,7 @@ from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from ..config import ExperimentConfig
@@ -29,6 +30,38 @@ def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
         targets = jax.nn.one_hot(labels, num_classes) * (on - off) + off
         return optax.softmax_cross_entropy(logits, targets)
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
+def eval_params(state) -> PyTree:
+    """EMA params when tracked, else the live params — the same preference
+    Trainer.eval_step applies."""
+    return state.ema_params if state.ema_params is not None else state.params
+
+
+def realized_eval_batches(trainer, eval_batch: int, eval_iter_fn,
+                          compute, batch_keys: Tuple[str, ...] = ()):
+    """Drive a jitted ``compute(dev_batch)`` over the eval set and realize
+    results to host: yields ``(outputs, batch_subset, eval_mask_or_None)``
+    per batch, each as numpy-compatible host values. In multi-process runs
+    the outputs (and the requested batch keys + eval_mask) are allgathered
+    so every process sees the full global batch — final acceptance metrics
+    (BLEU, mAP) are then exact everywhere, not per-shard approximations.
+    """
+    for batch in eval_iter_fn():
+        dev = trainer.device_batch(batch, global_batch=eval_batch)
+        out = compute(dev)
+        extra = {k: dev[k] for k in batch_keys}
+        emask = dev.get("eval_mask")
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            out, extra = multihost_utils.process_allgather((out, extra))
+            if emask is not None:
+                emask = multihost_utils.process_allgather(emask)
+        out = jax.device_get(out)
+        extra = jax.device_get(extra)
+        emask = None if emask is None else np.asarray(jax.device_get(emask))
+        yield out, extra, emask
 
 
 def example_mask(batch: Dict[str, jnp.ndarray], n: int) -> jnp.ndarray:
@@ -203,6 +236,49 @@ class Seq2SeqTask:
         ids = jnp.zeros((1, s), jnp.int32)
         return self.model.init(rng, ids, jnp.ones((1, s), jnp.int32), ids,
                                train=False)
+
+    def final_eval(self, state, eval_iter_fn, trainer) -> Dict[str, float]:
+        """Decode the eval set (models/decoding.py) and score corpus BLEU —
+        the Sockeye workload's acceptance metric (BASELINE.md row 6).
+
+        Runs the beam (or greedy, beam_size<=1) searcher jit-compiled over
+        the mesh-sharded eval batches; hypotheses/references are realized to
+        host and scored with metrics/bleu.py. Multi-process runs allgather
+        the decoded ids so every process scores the full eval set.
+        """
+        from ..metrics.bleu import corpus_bleu
+        from ..models.decoding import beam_decode, greedy_decode, \
+            strip_special
+
+        ev = self.cfg.eval
+        if not ev.enabled:
+            return {}
+        max_len = ev.max_decode_len or self.cfg.data.seq_len
+        variables = {"params": eval_params(state)}
+
+        if ev.beam_size <= 1:
+            decode = jax.jit(lambda v, src, mask: greedy_decode(
+                self.model, v, src, mask, max_len))
+        else:
+            decode = jax.jit(lambda v, src, mask: beam_decode(
+                self.model, v, src, mask, max_len, ev.beam_size,
+                ev.length_penalty)[0])
+
+        eb = self.cfg.train.eval_batch or self.cfg.train.global_batch
+        hyps, refs = [], []
+        for out, extra, emask in realized_eval_batches(
+                trainer, eb, eval_iter_fn,
+                lambda dev: decode(variables, dev["src_ids"],
+                                   dev["src_mask"]),
+                batch_keys=("tgt_out_ids",)):
+            out = np.asarray(out)
+            tgt = np.asarray(extra["tgt_out_ids"])
+            for i in range(out.shape[0]):
+                if emask is not None and emask[i] == 0:
+                    continue
+                hyps.append(strip_special(out[i]))
+                refs.append(strip_special(tgt[i]))
+        return {"bleu": corpus_bleu(hyps, refs, smooth=True)}
 
     def loss_fn(self, params, batch_stats, batch, rng, train):
         rngs = {"dropout": rng} if (train and rng is not None) else None

@@ -176,6 +176,45 @@ def _tiny_cfg():
     )
 
 
+def test_detect_one_postprocessing():
+    """Per-class NMS + global top-K on hand-crafted head outputs: duplicate
+    boxes of the same class are suppressed, same-position boxes of distinct
+    classes both survive, sub-threshold and invalid proposals drop out."""
+    from deeplearning_cfn_tpu.train.detection_task import DetectionTask
+
+    task = DetectionTask(_tiny_cfg())
+    p, c = 6, 3  # 6 proposals, background + 2 foreground classes
+    props = jnp.asarray(np.array([
+        [0, 0, 10, 10],
+        [0, 1, 10, 11],    # heavy overlap with 0 → NMS victim (class 1)
+        [30, 30, 40, 40],  # distinct location, class 2
+        [0, 0, 10, 10],    # same place as 0 but class 2 → must survive
+        [50, 50, 60, 60],  # below score threshold
+        [70, 70, 80, 80],  # invalid proposal
+    ], np.float32))
+    valid = jnp.asarray([True, True, True, True, True, False])
+    probs = np.full((p, c), 0.01, np.float32)
+    probs[0, 1] = 0.9
+    probs[1, 1] = 0.8
+    probs[2, 2] = 0.7
+    probs[3, 2] = 0.6
+    probs[4, 1] = 0.04  # below the 0.05 floor
+    probs[5, 1] = 0.9   # invalid → ignored
+    deltas = jnp.zeros((p, c, 4), np.float32)
+    boxes, scores, classes = task._detect_one(
+        jnp.asarray(probs), deltas, props, valid,
+        topk=4, score_thr=0.05, nms_iou=0.5)
+    boxes, scores, classes = map(np.asarray, (boxes, scores, classes))
+    kept = [(int(c_), float(s)) for c_, s in zip(classes, scores) if c_ > 0]
+    assert kept == [(1, pytest.approx(0.9)), (2, pytest.approx(0.7)),
+                    (2, pytest.approx(0.6))], kept
+    # Survivor boxes: 0 (cls 1), 2 and 3 (cls 2) — deltas were zero so the
+    # output boxes equal the proposals.
+    np.testing.assert_allclose(boxes[0], props[0])
+    np.testing.assert_allclose(boxes[1], props[2])
+    np.testing.assert_allclose(boxes[2], props[3])
+
+
 def test_maskrcnn_trains_end_to_end(tmp_workdir):
     """Full pipeline: synthetic COCO → RPN/RoI/mask losses all finite and
     the total improving over a short horizon."""
@@ -184,7 +223,8 @@ def test_maskrcnn_trains_end_to_end(tmp_workdir):
     cfg.train.steps = 6  # CPU detection steps are ~40s; keep the horizon short
     cfg.train.eval_every_steps = 1000  # skip mid-run eval (compile cost)
     cfg.data.prefetch = 0
-    run_experiment(cfg)
+    cfg.eval.detect_topk = 8  # keep the inference compile small on CPU
+    final = run_experiment(cfg)
     records = [r for r in read_metrics(
         os.path.join(cfg.workdir, "maskrcnn_resnet50", "metrics.jsonl"))
         if "loss" in r]
@@ -195,6 +235,18 @@ def test_maskrcnn_trains_end_to_end(tmp_workdir):
             assert key in r and np.isfinite(r[key]), (key, r)
     first, last = records[0], records[-1]
     assert last["loss"] < first["loss"], (first["loss"], last["loss"])
+    # Acceptance metric: the final eval runs the static-shape inference path
+    # (per-class NMS → fixed-K boxes + masks) and scores COCO-style mAP —
+    # 6 steps won't produce detections that match GT, but the full pipeline
+    # must execute and land final_eval_map / final_eval_mask_map in
+    # metrics.jsonl (BASELINE.md tracking row 5).
+    for key in ("map", "map50", "mask_map"):
+        assert key in final and np.isfinite(final[key]) \
+            and 0.0 <= final[key] <= 1.0, (key, final)
+    logged = [r for r in read_metrics(
+        os.path.join(cfg.workdir, "maskrcnn_resnet50", "metrics.jsonl"))
+        if "final_eval_map" in r]
+    assert logged and "final_eval_mask_map" in logged[-1]
 
 
 def test_maskrcnn_spatial_shard_compiles(devices, tmp_workdir):
@@ -207,5 +259,10 @@ def test_maskrcnn_spatial_shard_compiles(devices, tmp_workdir):
     cfg.train.steps = 2
     cfg.train.eval_every_steps = 1000
     cfg.data.prefetch = 0
+    # Keep final eval ON: the inference path (predict_fn's NMS/top-k/
+    # roi-align) must also compile and run with the image spatially sharded
+    # — a production multichip run hits it at the very end of training.
+    cfg.eval.detect_topk = 4
     final = run_experiment(cfg)
     assert np.isfinite(final["loss"])
+    assert "map" in final and np.isfinite(final["map"])

@@ -279,9 +279,22 @@ def test_nmt_trains_end_to_end(tmp_workdir):
                                 warmup_steps=5),
         mesh=MeshConfig(data=-1),
     )
-    _run(cfg, tmp_workdir, steps=120)
+    final = _run(cfg, tmp_workdir, steps=300)
     records = [r for r in read_metrics(
         os.path.join(cfg.workdir, "transformer_nmt_tiny", "metrics.jsonl"))
         if "loss" in r]
     first, last = records[0], records[-1]
     assert last["loss"] < first["loss"] - 0.5, (first, last)
+    # Acceptance metric: the final eval beam-decodes the eval set and scores
+    # corpus BLEU (the Sockeye workload's yardstick). The target transform
+    # (reverse + offset) is deterministic, so a model that learned anything
+    # scores well above a random decoder's ~0 BLEU — and the number must
+    # land in metrics.jsonl as final_eval_bleu.
+    assert "bleu" in final, final
+    assert 0.0 <= final["bleu"] <= 1.0
+    assert final["bleu"] > 0.05, final["bleu"]
+    logged = [r for r in read_metrics(
+        os.path.join(cfg.workdir, "transformer_nmt_tiny", "metrics.jsonl"))
+        if "final_eval_bleu" in r]
+    assert logged and logged[-1]["final_eval_bleu"] == \
+        pytest.approx(final["bleu"])
