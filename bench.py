@@ -1,22 +1,36 @@
 """Headline benchmark: ResNet-50 training throughput, images/sec/chip.
 
 Driver contract: prints ONE JSON line {"metric", "value", "unit",
-"vs_baseline"} (plus "mfu" and diagnostics). The measurement itself lives in
-deeplearning_cfn_tpu/bench.py (full train step — fwd + bwd + LARS update —
-on synthetic ImageNet-shaped data, bf16, donated buffers, pipelined timed
-block with one trailing data-dependent sync, MFU from XLA cost analysis).
+"vs_baseline"} (plus "mfu", "measured" and diagnostics). The measurement
+itself lives in deeplearning_cfn_tpu/bench.py (full train step — fwd + bwd +
+LARS update — on synthetic ImageNet-shaped data, bf16, donated buffers,
+pipelined timed block with one trailing data-dependent sync, MFU from XLA
+cost analysis).
 
-This wrapper exists for resilience: on this image the TPU backend ("axon"
-plugin) is flaky — init can FAIL (r01: RuntimeError at jax.device_count) or
-HANG (judge repro: process blocked at ~0 CPU for 600 s). An in-process
-retry cannot recover from a hang, so each attempt runs the measurement in a
-fresh subprocess with a hard timeout, retrying with backoff; a fresh process
-also guarantees retries aren't poisoned by jax's cached failed-backend
-state. If every attempt fails, the contract JSON is still printed with an
-"error" field — the driver always gets a parseable record, never a
-traceback.
+This wrapper exists for resilience AND diagnosability: on this image the TPU
+backend ("axon" plugin) is flaky — init can FAIL (r01: RuntimeError at
+jax.device_count) or HANG (r02 + judge repro: process blocked for 280-600 s
+before jax.devices() returns). Strategy, informed by both failures:
+
+- Each attempt is a fresh subprocess with a hard timeout (an in-process
+  retry cannot recover from a hang, and a fresh process isn't poisoned by
+  jax's cached failed-backend state).
+- TWO attempts that split the whole remaining budget, not three short ones:
+  against a slow init, one ~430 s attempt succeeds where three <300 s
+  attempts all die (r02: attempt 2 got only 229 s, attempt 3 never ran).
+- The child emits "[bench-stage] t=+Xs <name>" markers on stderr (import_jax
+  / backend_init / devices_ok / build / first_compile / warmup / timed /
+  done). On failure the LAST marker is parsed into the error field, so a red
+  bench localizes the hang to an exact phase instead of reading "timeout".
+- On total failure the contract JSON carries "measured": false (a 0.0 value
+  with rc 0 must not be mistaken for a real measurement).
 
 Do NOT force the CPU backend here: this runs on the real chip.
+
+Env overrides the driver (or an operator) can set:
+  DLCFN_BENCH_PRESET, DLCFN_BENCH_STEPS, DLCFN_BENCH_WARMUP,
+  DLCFN_BENCH_GLOBAL_BATCH, DLCFN_BENCH_TOTAL_BUDGET_S,
+  DLCFN_BENCH_ATTEMPT_RESERVE_S (kept back for attempt 2).
 
 vs_baseline: the reference repo publishes no numbers (BASELINE.json
 "published": {}), so the ratio is computed against the external context
@@ -29,20 +43,23 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import subprocess
 import sys
 import time
 
 METRIC = "imagenet_resnet50_train_images_per_sec_per_chip"
 UNIT = "images/sec/chip"
-ATTEMPT_TIMEOUT_S = int(os.environ.get("DLCFN_BENCH_ATTEMPT_TIMEOUT_S",
-                                       "300"))  # normal run ~2-3 min
 # Hard wall for the whole wrapper: it must finish (and print the contract
 # JSON) before the DRIVER's own timeout kills it — r01's harness killed the
 # multichip gate at ~600 s, so stay safely under that.
 TOTAL_BUDGET_S = int(os.environ.get("DLCFN_BENCH_TOTAL_BUDGET_S", "540"))
-BACKOFFS_S = (0.0, 10.0, 20.0)  # sleep before each attempt
+# Seconds held back from attempt 1 so a short attempt 2 exists at all
+# (covers the "init flaked once, works on retry" mode).
+ATTEMPT_RESERVE_S = int(os.environ.get("DLCFN_BENCH_ATTEMPT_RESERVE_S", "100"))
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+_STAGE_RE = re.compile(r"\[bench-stage\] (t=\+[0-9.]+s .+)")
 
 
 def _parse_record(stdout: str):
@@ -58,40 +75,57 @@ def _parse_record(stdout: str):
     return None
 
 
+def _last_stage(stderr) -> str:
+    """The child's last stage marker — where it died or hung."""
+    if isinstance(stderr, bytes):
+        stderr = stderr.decode("utf-8", "replace")
+    stages = _STAGE_RE.findall(stderr or "")
+    return stages[-1] if stages else "no stage marker (died before main)"
+
+
 def main() -> None:
     child = [
         sys.executable, "-m", "deeplearning_cfn_tpu.bench",
-        "--preset", "imagenet_resnet50", "--steps", "30", "--warmup", "5",
+        "--preset", os.environ.get("DLCFN_BENCH_PRESET", "imagenet_resnet50"),
+        "--steps", os.environ.get("DLCFN_BENCH_STEPS", "30"),
+        "--warmup", os.environ.get("DLCFN_BENCH_WARMUP", "5"),
     ]
+    gb = os.environ.get("DLCFN_BENCH_GLOBAL_BATCH")
+    if gb:
+        child += ["--global-batch", gb]
     errors = []
     deadline = time.monotonic() + TOTAL_BUDGET_S
-    for i, backoff in enumerate(BACKOFFS_S):
-        if backoff:
-            time.sleep(backoff)
+    for attempt in (1, 2):
         remaining = deadline - time.monotonic()
         if remaining < 60:
-            errors.append(f"attempt {i + 1}: skipped, total budget "
+            errors.append(f"attempt {attempt}: skipped, total budget "
                           f"({TOTAL_BUDGET_S}s) exhausted")
             break
-        attempt_timeout = min(ATTEMPT_TIMEOUT_S, int(remaining))
+        # Attempt 1 gets everything except the reserve; attempt 2 gets
+        # whatever is actually left.
+        attempt_timeout = int(remaining - ATTEMPT_RESERVE_S) \
+            if attempt == 1 else int(remaining)
+        attempt_timeout = max(attempt_timeout, 60)
         try:
             proc = subprocess.run(
                 child, capture_output=True, text=True,
                 timeout=attempt_timeout, cwd=REPO_ROOT,
             )
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
             errors.append(
-                f"attempt {i + 1}: timeout after {attempt_timeout}s "
-                "(TPU backend init can hang on this image)"
+                f"attempt {attempt}: timeout after {attempt_timeout}s; "
+                f"last stage: {_last_stage(e.stderr)}"
             )
             continue
         record = _parse_record(proc.stdout)
         if proc.returncode == 0 and record is not None:
+            record.setdefault("measured", True)
             print(json.dumps(record))
             return
-        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-6:]
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-4:]
         errors.append(
-            f"attempt {i + 1}: rc={proc.returncode}: " + " | ".join(tail)
+            f"attempt {attempt}: rc={proc.returncode}; last stage: "
+            f"{_last_stage(proc.stderr)}; tail: " + " | ".join(tail)
         )
     print(json.dumps({
         "metric": METRIC,
@@ -99,6 +133,7 @@ def main() -> None:
         "unit": UNIT,
         "vs_baseline": 0.0,
         "mfu": 0.0,
+        "measured": False,
         "error": " || ".join(errors)[-2000:],
     }))
 

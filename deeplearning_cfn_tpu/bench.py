@@ -8,8 +8,21 @@ case of this harness for the driver contract.
 
 from __future__ import annotations
 
+import sys
 import time
 from typing import Dict, Optional
+
+_T0 = time.monotonic()
+
+
+def stage(name: str, **info) -> None:
+    """Emit a stage-timestamped marker to stderr. The wrapper (root
+    bench.py) parses the LAST marker out of a timed-out child's stderr, so
+    a hang is localized to the exact phase (plugin import? device enum?
+    first compile?) instead of reading as a bare 'timeout'."""
+    extra = "".join(f" {k}={v}" for k, v in info.items())
+    print(f"[bench-stage] t=+{time.monotonic() - _T0:.1f}s {name}{extra}",
+          file=sys.stderr, flush=True)
 
 # External context anchor (BASELINE.md): TF+Horovod ResNet-50 on V100, the
 # stack the reference's flagship workload ran on (~375 img/s/GPU, Horovod
@@ -73,7 +86,22 @@ def run_bench(
 ) -> Dict:
     """Run ``steps`` timed train steps of ``preset`` on synthetic data and
     return the one-line JSON record the driver expects."""
+    stage("import_jax")
+    import os
+
     import jax
+
+    # On this image a sitecustomize pre-registers the TPU PJRT plugin, and
+    # the env var alone does not stop its (hang-prone) init — the platform
+    # list must also be set in-process before first backend use. No-op when
+    # the env var is unset (real-chip runs).
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    stage("backend_init")  # first jax.devices() triggers PJRT client init
+    devices = jax.devices()
+    stage("devices_ok", n=len(devices),
+          kind=getattr(devices[0], "device_kind", "unknown"))
     import numpy as np
 
     from .config import MeshConfig, apply_overrides
@@ -90,11 +118,12 @@ def run_bench(
         cfg.train.global_batch = global_batch
     elif jax.device_count() == 1:
         # Single-chip bench: a per-chip-sized batch, not the pod-sized one.
-        # Measured on v5p (2026-07): 512 beats 128 by ~1.7x for ResNet-50
-        # (MXU utilization; step time still < 0.3 s).
+        # Sized to saturate the MXU without blowing HBM; override with
+        # --global-batch (or DLCFN_BENCH_GLOBAL_BATCH via the wrapper) to
+        # sweep.
         per_chip = {"imagenet_resnet50": 512, "cifar10_resnet20": 512,
                     "bert_base_wikipedia": 32, "transformer_nmt_wmt": 64,
-                    "maskrcnn_coco": 1}.get(preset, 64)
+                    "maskrcnn_coco": 4}.get(preset, 64)
         cfg.train.global_batch = per_chip
     apply_overrides(cfg, ["data.prefetch=0", "data.synthetic=true"])
     # One batch is all the bench consumes — don't materialize the default
@@ -115,6 +144,7 @@ def run_bench(
                       spatial_dim=getattr(task, "spatial_dim", None),
                       spatial_keys=getattr(task, "spatial_keys", None))
 
+    stage("build", preset=preset, global_batch=gb)
     pipe = build_pipeline(cfg.data, local_batch_size(gb, mesh),
                           cfg.model.num_classes, seed=0, train=True)
     host_batch = next(iter(pipe.one_epoch(0)))
@@ -123,14 +153,17 @@ def run_bench(
 
     # One AOT compile, reused for execution AND cost analysis — calling
     # trainer.train_step would jit-compile a second, separate executable.
+    stage("first_compile")
     compiled_step = trainer.train_step.lower(
         state, dev_batch, step_rng).compile()
 
     # Warmup (cache effects); sync via a scalar device→host read — some
     # PJRT transports complete ready-events before execution finishes.
+    stage("warmup", n=max(warmup, 1))
     for _ in range(max(warmup, 1)):
         state, m = compiled_step(state, dev_batch, step_rng)
     float(m["loss"])
+    stage("timed", steps=steps)
 
     # Timed block: dispatch every step back-to-back with NO per-step sync —
     # steady-state pipelined throughput, the number that matters at pod
@@ -167,7 +200,9 @@ def run_bench(
         "n_chips": n_chips,
         "mean_step_s": round(mean_step_s, 5),
         "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "measured": True,
     }
+    stage("done", value=record["value"])
     return record
 
 
@@ -183,6 +218,7 @@ def main(argv=None) -> None:
     parser.add_argument("--warmup", type=int, default=5)
     parser.add_argument("--global-batch", type=int, default=0)
     args = parser.parse_args(argv)
+    stage("start", preset=args.preset)
     record = run_bench(preset=args.preset, steps=args.steps,
                        warmup=args.warmup, global_batch=args.global_batch)
     print(json.dumps(record), flush=True)

@@ -64,11 +64,19 @@ def corpus_bleu(hypotheses: List[Sequence[int]],
         totals += s["totals"]
         hyp_len += int(s["hyp_len"])
         ref_len += int(s["ref_len"])
+    # Effective order (sacrebleu-style): orders the corpus cannot produce
+    # at all (every hypothesis shorter than n → totals == 0) are excluded
+    # rather than scored — bumping them to 1/1 under smoothing would grant
+    # perfect precision to impossible n-grams and inflate short outputs.
+    usable = totals > 0
+    if not usable.any():
+        return 0.0
+    matches, totals = matches[usable], totals[usable]
     if smooth:
         zero = matches == 0
         matches = matches + zero
         totals = totals + zero
-    if np.any(totals == 0) or np.any(matches == 0):
+    if np.any(matches == 0):
         return 0.0
     log_prec = np.mean(np.log(matches / totals))
     if hyp_len == 0:

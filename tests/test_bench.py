@@ -1,0 +1,67 @@
+"""Bench harness: wrapper parsing (stage diagnosis, record contract) and a
+tiny real run of the in-package measurement on the CPU backend."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_wrapper():
+    spec = importlib.util.spec_from_file_location(
+        "root_bench", os.path.join(REPO_ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_wrapper_parses_contract_record():
+    w = _load_wrapper()
+    out = "\n".join([
+        "noise",
+        json.dumps({"metric": "m", "value": 1.5, "unit": "u"}),
+        "[other] trailing line",
+    ])
+    rec = w._parse_record(out)
+    assert rec == {"metric": "m", "value": 1.5, "unit": "u"}
+    assert w._parse_record("no json here") is None
+    assert w._parse_record("{broken") is None
+
+
+def test_wrapper_extracts_last_stage():
+    w = _load_wrapper()
+    err = ("[bench-stage] t=+0.0s start preset=x\n"
+           "[bench-stage] t=+0.1s import_jax\n"
+           "some warning\n"
+           "[bench-stage] t=+0.2s backend_init\n")
+    assert w._last_stage(err) == "t=+0.2s backend_init"
+    assert w._last_stage(err.encode()) == "t=+0.2s backend_init"
+    assert "no stage marker" in w._last_stage("")
+    assert "no stage marker" in w._last_stage(None)
+
+
+def test_bench_child_measures_on_cpu():
+    """The child process measures a tiny preset on the forced-CPU backend,
+    prints the contract JSON with measured=true, and emits every stage
+    marker through 'done' on stderr."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    proc = subprocess.run(
+        [sys.executable, "-m", "deeplearning_cfn_tpu.bench",
+         "--preset", "cifar10_resnet20", "--steps", "3", "--warmup", "1",
+         "--global-batch", "32"],
+        capture_output=True, text=True, timeout=300, cwd=REPO_ROOT, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["measured"] is True
+    assert rec["value"] > 0
+    assert rec["unit"] == "images/sec/chip"
+    assert rec["global_batch"] == 32
+    for name in ("start", "import_jax", "backend_init", "devices_ok",
+                 "build", "first_compile", "warmup", "timed", "done"):
+        assert f"s {name}" in proc.stderr, (name, proc.stderr[-2000:])

@@ -62,6 +62,15 @@ def test_bleu_clipping():
     assert 0.0 < smoothed < 2 / 6
 
 
+def test_bleu_smoothing_does_not_reward_impossible_orders():
+    # 2-token hypothesis has zero 3/4-grams; smoothing must not grant those
+    # orders 1/1 precision. Effective order here is {1,2}-grams:
+    # p1 = 1/2, p2 smoothed = 1/2 → BLEU = 0.5 (NOT sqrt(0.5) ≈ 0.707).
+    assert corpus_bleu([[3, 9]], [[3, 4]], smooth=True) == pytest.approx(0.5)
+    # And a perfect-but-short pair scores 1.0 under effective order.
+    assert corpus_bleu([[3, 4, 5]], [[3, 4, 5]]) == pytest.approx(1.0)
+
+
 def test_bleu_corpus_level_not_mean_of_sentences():
     # One perfect long pair + one disjoint short pair: corpus BLEU pools
     # counts, so the result is strictly between 0 and 1 (a mean of
