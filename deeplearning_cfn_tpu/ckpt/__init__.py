@@ -4,3 +4,10 @@ from .checkpoint import (  # noqa: F401
     restore_checkpoint,
     save_checkpoint,
 )
+from .store import (  # noqa: F401
+    GcsStore,
+    MemoryObjectStore,
+    PosixStore,
+    Store,
+    open_store,
+)

@@ -8,42 +8,52 @@ TPU rebuild does it properly for sharded state:
   as ``shards_p<K>.npz`` plus its own ``manifest_p<K>.json`` listing which
   global index ranges those shards cover; process 0 additionally writes the
   tree-level ``manifest.json`` (leaf names, shapes, dtypes);
-- commit is filesystem-only (NO device collective, so it is safe on a
+- commit is storage-only (NO device collective, so it is safe on a
   background thread concurrent with training collectives): each process
-  drops a ``DONE_p<K>`` marker after its files are durable, and process 0
+  drops a ``DONE_p<K>`` marker after its objects are durable, and process 0
   writes ``COMMIT`` only once all markers exist — partial checkpoints are
   never visible, the atomicity EFS + rank-0-saves never guaranteed;
 - restore merges every process's manifest, reassembles global arrays, and
   places them with the *current* mesh's shardings, so a checkpoint taken on
   one topology restores onto another (resize-via-resume, §4.5 — TPU slices
   are not elastic, so this IS the scaling story);
-- async mode hands the host-side file write to a background thread after the
+- async mode hands the host-side write to a background thread after the
   device→host copy, overlapping with the next training steps.
 
-Format: ``<dir>/step_<N>/{manifest.json, manifest_p<K>.json,
+Storage is pluggable (store.py): ``directory`` may be a POSIX path, a
+``gs://bucket/prefix`` url (the EFS role per SURVEY §6), or any
+:class:`~.store.Store` instance. The protocol only needs atomic
+whole-object puts, so it runs unchanged on object stores.
+
+Layout: ``<root>/step_<N>/{manifest.json, manifest_p<K>.json,
 shards_p<K>.npz, DONE_p<K>, COMMIT}``.
 """
 
 from __future__ import annotations
 
-import glob
 import json
 import os
-import shutil
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import jax
 import numpy as np
 
 from ..utils.trees import flatten_with_names
+from .store import Store, open_store
 
 PyTree = Any
 
 _MANIFEST = "manifest.json"
 _COMMIT = "COMMIT"
 _DONE_TIMEOUT_S = 600.0
+
+StoreOrPath = Union[str, Store]
+
+
+def _step_key(step: int) -> str:
+    return f"step_{step:08d}"
 
 
 # -- save -------------------------------------------------------------------
@@ -78,21 +88,22 @@ def _index_to_json(index, shape) -> List[List[int]]:
 
 
 def save_checkpoint(
-    directory: str,
+    directory: StoreOrPath,
     step: int,
     state: PyTree,
     keep: int = 0,
     async_write: bool = False,
     _thread_holder: Optional[List[threading.Thread]] = None,
 ) -> str:
-    """Write one checkpoint. Multi-host safe; returns the checkpoint dir."""
-    ckpt_dir = os.path.join(directory, f"step_{step:08d}")
+    """Write one checkpoint. Multi-host safe; returns the checkpoint
+    location (a filesystem path for POSIX stores, else ``<store> key``)."""
+    store = open_store(directory)
+    key = _step_key(step)
     pidx = jax.process_index()
     pcount = jax.process_count()
-    os.makedirs(ckpt_dir, exist_ok=True)
 
     flat, _ = flatten_with_names(state)
-    # Device→host copy happens synchronously (HBM→RAM); the file write is
+    # Device→host copy happens synchronously (HBM→RAM); the object write is
     # what async mode defers to the background thread.
     tree_manifest: Dict[str, Any] = {"step": step, "processes": pcount,
                                      "leaves": {}}
@@ -110,44 +121,40 @@ def save_checkpoint(
         }
         entries = []
         for i, (index, data) in enumerate(shards):
-            key = f"{name}::{i}"
-            arrays[key] = data
-            entries.append({"key": key,
+            akey = f"{name}::{i}"
+            arrays[akey] = data
+            entries.append({"key": akey,
                             "index": _index_to_json(index, shape)})
         proc_manifest["leaves"][name] = entries
 
     def write_files():
-        # 1. This process's shard file + manifest (atomic via rename).
-        shard_path = os.path.join(ckpt_dir, f"shards_p{pidx}.npz")
-        tmp = shard_path + ".tmp.npz"  # savez appends .npz unless present
-        np.savez(tmp, **arrays)
-        os.replace(tmp, shard_path)
-        with open(os.path.join(ckpt_dir, f"manifest_p{pidx}.json.tmp"),
-                  "w") as fh:
-            json.dump(proc_manifest, fh)
-        os.replace(os.path.join(ckpt_dir, f"manifest_p{pidx}.json.tmp"),
-                   os.path.join(ckpt_dir, f"manifest_p{pidx}.json"))
+        # 1. This process's shard object + manifest (atomic puts).
+        store.put_npz(f"{key}/shards_p{pidx}.npz", arrays)
+        store.put_bytes(f"{key}/manifest_p{pidx}.json",
+                        json.dumps(proc_manifest).encode())
         if pidx == 0:
-            with open(os.path.join(ckpt_dir, _MANIFEST), "w") as fh:
-                json.dump(tree_manifest, fh)
-        # 2. Marker, then filesystem-level commit rendezvous. No device
+            store.put_bytes(f"{key}/{_MANIFEST}",
+                            json.dumps(tree_manifest).encode())
+        # 2. Marker, then storage-level commit rendezvous. No device
         # collective here: a barrier on this thread could interleave with
         # training collectives on the main thread and deadlock the pod.
-        with open(os.path.join(ckpt_dir, f"DONE_p{pidx}"), "w") as fh:
-            fh.write(str(step))
+        store.put_bytes(f"{key}/DONE_p{pidx}", str(step).encode())
         if pidx == 0:
             deadline = time.time() + _DONE_TIMEOUT_S
-            while len(glob.glob(os.path.join(ckpt_dir, "DONE_p*"))) < pcount:
+            sleep_s = 0.05  # backoff: a list() is an API call on GCS
+            while len([k for k in store.list(f"{key}/")
+                       if k.rsplit("/", 1)[-1].startswith("DONE_p")]) \
+                    < pcount:
                 if time.time() > deadline:  # pragma: no cover
                     print(f"[dlcfn-tpu] WARNING: checkpoint step {step} not "
                           f"committed: missing DONE markers after "
                           f"{_DONE_TIMEOUT_S}s")
                     return
-                time.sleep(0.05)
-            with open(os.path.join(ckpt_dir, _COMMIT), "w") as fh:
-                fh.write(str(step))
+                time.sleep(sleep_s)
+                sleep_s = min(sleep_s * 1.6, 2.0)
+            store.put_bytes(f"{key}/{_COMMIT}", str(step).encode())
             if keep > 0:
-                _garbage_collect(directory, keep)
+                _garbage_collect(store, keep)
 
     if async_write:
         t = threading.Thread(target=write_files, daemon=True)
@@ -156,38 +163,38 @@ def save_checkpoint(
             _thread_holder.append(t)
     else:
         write_files()
-    return ckpt_dir
+    if isinstance(directory, str) and not directory.startswith("gs://"):
+        return os.path.join(directory, key)
+    return f"{store.describe()} {key}"
 
 
-def _garbage_collect(directory: str, keep: int):
-    steps = sorted(_committed_steps(directory))
+def _garbage_collect(store: Store, keep: int):
+    steps = sorted(_committed_steps(store))
     for step in steps[:-keep]:
-        shutil.rmtree(os.path.join(directory, f"step_{step:08d}"),
-                      ignore_errors=True)
+        store.delete_prefix(f"{_step_key(step)}/")
 
 
 # -- restore ----------------------------------------------------------------
 
 
-def _committed_steps(directory: str) -> List[int]:
-    if not os.path.isdir(directory):
-        return []
+def _committed_steps(directory: StoreOrPath) -> List[int]:
+    store = open_store(directory)
     out = []
-    for name in os.listdir(directory):
-        if name.startswith("step_") and os.path.exists(
-            os.path.join(directory, name, _COMMIT)
-        ):
-            out.append(int(name[len("step_"):]))
+    for key in store.list(""):
+        parts = key.split("/")
+        if len(parts) == 2 and parts[0].startswith("step_") \
+                and parts[1] == _COMMIT:
+            out.append(int(parts[0][len("step_"):]))
     return out
 
 
-def latest_checkpoint(directory: str) -> Optional[int]:
+def latest_checkpoint(directory: StoreOrPath) -> Optional[int]:
     steps = _committed_steps(directory)
     return max(steps) if steps else None
 
 
 def restore_checkpoint(
-    directory: str,
+    directory: StoreOrPath,
     target: PyTree,
     step: Optional[int] = None,
     shardings: Optional[PyTree] = None,
@@ -198,44 +205,47 @@ def restore_checkpoint(
     ``shardings`` is given (or target leaves are jax.Arrays with shardings),
     restored arrays are placed with those shardings — including when the
     saving topology differed (global arrays are reassembled from every
-    process's shard file first, which must all be visible on shared storage).
+    process's shard object first, which must all be visible in the store).
     """
+    store = open_store(directory)
     if step is None:
-        step = latest_checkpoint(directory)
+        step = latest_checkpoint(store)
         if step is None:
-            raise FileNotFoundError(f"no committed checkpoint in {directory}")
-    ckpt_dir = os.path.join(directory, f"step_{step:08d}")
-    with open(os.path.join(ckpt_dir, _MANIFEST)) as fh:
-        manifest = json.load(fh)
+            raise FileNotFoundError(f"no committed checkpoint in "
+                                    f"{store.describe()}")
+    key = _step_key(step)
+    manifest = json.loads(store.get_bytes(f"{key}/{_MANIFEST}"))
 
     # Merge every process's shard listing; data is keyed per-process so
     # identical keys from different processes cannot collide.
     shard_entries: Dict[str, List[Tuple[int, Dict]]] = {}
     shard_files: Dict[int, Any] = {}
-    for mpath in sorted(glob.glob(os.path.join(ckpt_dir, "manifest_p*.json"))):
-        with open(mpath) as fh:
-            pm = json.load(fh)
+    proc_manifests = sorted(
+        k for k in store.list(f"{key}/")
+        if k.rsplit("/", 1)[-1].startswith("manifest_p"))
+    for mkey in proc_manifests:
+        pm = json.loads(store.get_bytes(mkey))
         p = int(pm["process"])
         for name, entries in pm["leaves"].items():
             shard_entries.setdefault(name, []).extend(
                 (p, e) for e in entries
             )
-    expected = manifest.get("processes", len(shard_files) or 1)
-    found = len(glob.glob(os.path.join(ckpt_dir, "manifest_p*.json")))
-    if found < expected:
+    expected = manifest.get("processes", 1)
+    if len(proc_manifests) < expected:
         raise FileNotFoundError(
-            f"checkpoint has {found}/{expected} process manifests — "
-            f"incomplete copy on this filesystem?"
+            f"checkpoint has {len(proc_manifests)}/{expected} process "
+            f"manifests — incomplete copy in this store?"
         )
 
     def _load(p: int) -> Any:
         if p not in shard_files:
-            path = os.path.join(ckpt_dir, f"shards_p{p}.npz")
-            if not os.path.exists(path):
+            skey = f"{key}/shards_p{p}.npz"
+            if not store.exists(skey):
                 raise FileNotFoundError(
-                    f"missing shard file {path} — incomplete checkpoint copy?"
+                    f"missing shard object {skey} — incomplete checkpoint "
+                    f"copy?"
                 )
-            shard_files[p] = np.load(path)
+            shard_files[p] = store.get_npz(skey)
         return shard_files[p]
 
     def assemble(name: str, entry) -> Optional[np.ndarray]:
@@ -302,11 +312,15 @@ def restore_checkpoint(
 
 
 class CheckpointManager:
-    """Policy wrapper: save-every-N, keep-K, async, auto-resume."""
+    """Policy wrapper: save-every-N, keep-K, async, auto-resume. The
+    destination may be a POSIX directory, a gs:// url, or a Store."""
 
-    def __init__(self, directory: str, every_steps: int = 0, keep: int = 3,
-                 async_write: bool = True):
+    def __init__(self, directory: StoreOrPath, every_steps: int = 0,
+                 keep: int = 3, async_write: bool = True):
         self.directory = directory
+        # Resolve once: for gs:// paths this constructs the authenticated
+        # client a single time, not per save on the training cadence.
+        self.store = open_store(directory)
         self.every_steps = every_steps
         self.keep = keep
         self.async_write = async_write
@@ -319,15 +333,15 @@ class CheckpointManager:
         if not (force or self.should_save(step)):
             return
         self.wait()  # one in-flight async save at a time
-        save_checkpoint(self.directory, step, state, keep=self.keep,
+        save_checkpoint(self.store, step, state, keep=self.keep,
                         async_write=self.async_write,
                         _thread_holder=self._threads)
 
     def restore_or_none(self, target: PyTree, shardings=None):
-        step = latest_checkpoint(self.directory)
+        step = latest_checkpoint(self.store)
         if step is None:
             return None, None
-        return restore_checkpoint(self.directory, target, step, shardings)
+        return restore_checkpoint(self.store, target, step, shardings)
 
     def wait(self):
         for t in self._threads:

@@ -1,0 +1,241 @@
+"""Checkpoint storage backends: POSIX filesystem and object stores.
+
+SURVEY.md §6: in the reference, durability came from every node mounting the
+same EFS filesystem and rank 0 saving into it; "the EFS role is played by
+GCS" in the TPU rebuild. This module makes that pluggable: checkpoint.py
+speaks only the :class:`Store` interface (atomic whole-object put/get, list,
+delete, existence), so the same two-phase commit protocol (per-process
+DONE markers, then a COMMIT object) runs unchanged against:
+
+- :class:`PosixStore` — local or NFS-style shared directories (atomic via
+  write-to-tmp + rename);
+- :class:`GcsStore` — ``gs://bucket/prefix`` via google-cloud-storage
+  (object puts are already atomic — an object is never visible partially
+  written, exactly the property the commit protocol needs);
+- :class:`MemoryObjectStore` — an in-process fake with object-store
+  semantics (no rename, no partial writes, flat keyspace) used to test the
+  protocol without network.
+
+Keys are ``/``-separated paths relative to the store root, e.g.
+``step_00000100/shards_p0.npz``.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import shutil
+import threading
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+
+class Store:
+    """Atomic whole-object storage. All implementations must guarantee a
+    reader never observes a partially-written object — that property is
+    what makes the DONE/COMMIT two-phase protocol correct."""
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get_bytes(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def list(self, prefix: str = "") -> List[str]:
+        """All keys starting with ``prefix`` (recursive, unordered)."""
+        raise NotImplementedError
+
+    def delete_prefix(self, prefix: str) -> None:
+        raise NotImplementedError
+
+    # npz helpers: subclasses may override with streaming implementations.
+
+    def put_npz(self, key: str, arrays: Dict[str, np.ndarray]) -> None:
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        self.put_bytes(key, buf.getvalue())
+
+    def get_npz(self, key: str):
+        """Returns an npz mapping (caller must .close())."""
+        return np.load(io.BytesIO(self.get_bytes(key)))
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+
+class PosixStore(Store):
+    """Filesystem-backed store; atomicity via tmp-file + ``os.replace``.
+    Works on local disk and on POSIX-rename shared filesystems (NFS/EFS
+    equivalents) — the reference's durability model."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, *key.split("/"))
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+
+    def get_bytes(self, key: str) -> bytes:
+        with open(self._path(key), "rb") as fh:
+            return fh.read()
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    def list(self, prefix: str = "") -> List[str]:
+        # Walk only the deepest directory the prefix pins down — a
+        # "step_000123/" listing must not scan every retained checkpoint
+        # (the DONE-marker rendezvous polls this).
+        walk_root = self.root
+        if "/" in prefix:
+            walk_root = self._path(prefix.rsplit("/", 1)[0])
+        out = []
+        if not os.path.isdir(walk_root):
+            return out
+        for dirpath, _, files in os.walk(walk_root):
+            for name in files:
+                if name.endswith(".tmp") or name.endswith(".tmp.npz"):
+                    continue
+                full = os.path.join(dirpath, name)
+                key = os.path.relpath(full, self.root).replace(os.sep, "/")
+                if key.startswith(prefix):
+                    out.append(key)
+        return out
+
+    def delete_prefix(self, prefix: str) -> None:
+        # Fast path: a whole subdirectory.
+        path = self._path(prefix.rstrip("/"))
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+            return
+        for key in self.list(prefix):
+            try:
+                os.remove(self._path(key))
+            except OSError:
+                pass
+
+    def put_npz(self, key: str, arrays: Dict[str, np.ndarray]) -> None:
+        # Stream straight to disk instead of staging the whole npz in RAM.
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp.npz"  # savez appends .npz unless present
+        np.savez(tmp, **arrays)
+        os.replace(tmp, path)
+
+    def get_npz(self, key: str):
+        return np.load(self._path(key))
+
+    def describe(self) -> str:
+        return f"posix:{self.root}"
+
+
+class MemoryObjectStore(Store):
+    """In-process object store with GCS-like semantics: flat keyspace,
+    whole-object atomic puts, no rename. The protocol-correctness fake for
+    tests — checkpoint round-trips against this prove the two-phase commit
+    never depends on filesystem behaviors object stores lack."""
+
+    def __init__(self):
+        self._objects: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+        self.put_count = 0
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        with self._lock:
+            self._objects[key] = bytes(data)
+            self.put_count += 1
+
+    def get_bytes(self, key: str) -> bytes:
+        with self._lock:
+            if key not in self._objects:
+                raise FileNotFoundError(f"memory object store: no key {key!r}")
+            return self._objects[key]
+
+    def exists(self, key: str) -> bool:
+        with self._lock:
+            return key in self._objects
+
+    def list(self, prefix: str = "") -> List[str]:
+        with self._lock:
+            return [k for k in self._objects if k.startswith(prefix)]
+
+    def delete_prefix(self, prefix: str) -> None:
+        with self._lock:
+            for k in [k for k in self._objects if k.startswith(prefix)]:
+                del self._objects[k]
+
+    def describe(self) -> str:
+        return "memory-object-store"
+
+
+class GcsStore(Store):
+    """``gs://bucket/prefix`` via google-cloud-storage (lazy import: the
+    dependency is only needed when a gs:// path is actually used). GCS
+    object creation is atomic, satisfying the Store contract directly."""
+
+    def __init__(self, url: str):
+        if not url.startswith("gs://"):
+            raise ValueError(f"not a GCS url: {url!r}")
+        rest = url[len("gs://"):]
+        bucket, _, prefix = rest.partition("/")
+        if not bucket:
+            raise ValueError(f"no bucket in GCS url {url!r}")
+        try:
+            from google.cloud import storage  # type: ignore
+        except ImportError as e:  # pragma: no cover - env without the lib
+            raise ImportError(
+                "gs:// checkpoint paths need the google-cloud-storage "
+                "package; install it or use a mounted/POSIX directory"
+            ) from e
+        self._client = storage.Client()
+        self._bucket = self._client.bucket(bucket)
+        self._prefix = prefix.strip("/")
+        self.url = url
+
+    def _blob_name(self, key: str) -> str:
+        return f"{self._prefix}/{key}" if self._prefix else key
+
+    def put_bytes(self, key: str, data: bytes) -> None:
+        self._bucket.blob(self._blob_name(key)).upload_from_string(data)
+
+    def get_bytes(self, key: str) -> bytes:
+        return self._bucket.blob(self._blob_name(key)).download_as_bytes()
+
+    def exists(self, key: str) -> bool:
+        return self._bucket.blob(self._blob_name(key)).exists()
+
+    def list(self, prefix: str = "") -> List[str]:
+        full = self._blob_name(prefix)
+        start = len(self._prefix) + 1 if self._prefix else 0
+        return [b.name[start:]
+                for b in self._client.list_blobs(self._bucket, prefix=full)]
+
+    def delete_prefix(self, prefix: str) -> None:
+        full = self._blob_name(prefix)
+        for blob in list(self._client.list_blobs(self._bucket, prefix=full)):
+            blob.delete()
+
+    def describe(self) -> str:
+        return self.url
+
+
+def open_store(directory_or_store: Union[str, Store]) -> Store:
+    """Resolve a checkpoint destination: a Store passes through; a
+    ``gs://`` url opens GCS; anything else is a POSIX directory."""
+    if isinstance(directory_or_store, Store):
+        return directory_or_store
+    if isinstance(directory_or_store, str) and \
+            directory_or_store.startswith("gs://"):
+        return GcsStore(directory_or_store)
+    return PosixStore(directory_or_store)

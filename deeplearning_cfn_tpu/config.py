@@ -27,14 +27,23 @@ class MeshConfig:
                  DP-allreduce / KVStore dist_sync both map here).
       model    — tensor-parallel axis; reserved so pjit specs extend later.
       spatial  — image H/W sharding for Mask R-CNN's "data+spatial shard".
+      num_slices — multi-slice (DCN) scale-out: >1 builds a hybrid mesh
+                 with an outer 'dcn_data' axis spanning slice boundaries.
+                 Batch dim shards over (dcn_data, data) jointly; params stay
+                 replicated, so the gradient reduction is hierarchical —
+                 ICI within each slice, one DCN hop across slices (the
+                 reference's analogue: NCCL rings inside a node + TCP/EFA
+                 across nodes).
     """
 
     data: int = -1
     model: int = 1
     spatial: int = 1
+    num_slices: int = 1
 
     def axis_sizes(self) -> Dict[str, int]:
-        return {"data": self.data, "model": self.model, "spatial": self.spatial}
+        return {"dcn_data": self.num_slices, "data": self.data,
+                "model": self.model, "spatial": self.spatial}
 
 
 @dataclasses.dataclass

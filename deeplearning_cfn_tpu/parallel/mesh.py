@@ -22,10 +22,15 @@ from jax.sharding import Mesh
 
 from ..config import MeshConfig
 
-# Axis order matters: 'data' outermost so per-host batches stay contiguous
-# (each host feeds only its local shard of the batch), 'model' innermost so
-# tensor-parallel collectives ride the shortest ICI hops.
-AXIS_ORDER: Tuple[str, ...] = ("data", "spatial", "model")
+# Axis order matters: 'dcn_data' outermost (slice boundaries are the
+# slowest links — only the one gradient allreduce hop should cross them),
+# then 'data' so per-host batches stay contiguous (each host feeds only its
+# local shard of the batch), 'model' innermost so tensor-parallel
+# collectives ride the shortest ICI hops.
+AXIS_ORDER: Tuple[str, ...] = ("dcn_data", "data", "spatial", "model")
+# Batch dim 0 shards over both data axes jointly; with one slice the
+# dcn_data axis has size 1 and the spec degenerates to plain DP.
+BATCH_AXES: Tuple[str, ...] = ("dcn_data", "data")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,13 +40,15 @@ class MeshSpec:
     data: int
     model: int = 1
     spatial: int = 1
+    dcn_data: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.model * self.spatial
+        return self.data * self.model * self.spatial * self.dcn_data
 
     def axis_sizes(self) -> Dict[str, int]:
-        return {"data": self.data, "spatial": self.spatial, "model": self.model}
+        return {"dcn_data": self.dcn_data, "data": self.data,
+                "spatial": self.spatial, "model": self.model}
 
     @classmethod
     def resolve(cls, cfg: MeshConfig, num_devices: int) -> "MeshSpec":
@@ -50,22 +57,29 @@ class MeshSpec:
         by hand via ``$DEEPLEARNING_WORKERS_COUNT × GPUs``."""
         model = cfg.model
         spatial = cfg.spatial
-        if model < 1 or spatial < 1:
-            raise ValueError(f"model/spatial axes must be >=1, got {cfg}")
-        fixed = model * spatial
-        if num_devices % fixed != 0:
+        slices = getattr(cfg, "num_slices", 1)
+        if model < 1 or spatial < 1 or slices < 1:
+            raise ValueError(f"mesh axes must be >=1, got {cfg}")
+        if num_devices % slices != 0:
             raise ValueError(
-                f"model*spatial={fixed} does not divide device count {num_devices}"
+                f"num_slices={slices} does not divide device count "
+                f"{num_devices}")
+        per_slice = num_devices // slices
+        fixed = model * spatial
+        if per_slice % fixed != 0:
+            raise ValueError(
+                f"model*spatial={fixed} does not divide per-slice device "
+                f"count {per_slice}"
             )
         data = cfg.data
         if data == -1:
-            data = num_devices // fixed
-        if data * fixed != num_devices:
+            data = per_slice // fixed
+        if data * fixed != per_slice:
             raise ValueError(
-                f"mesh {data}x{spatial}x{model} != {num_devices} devices; "
+                f"mesh {data}x{spatial}x{model} != {per_slice} devices/slice; "
                 f"set data=-1 to auto-size"
             )
-        return cls(data=data, model=model, spatial=spatial)
+        return cls(data=data, model=model, spatial=spatial, dcn_data=slices)
 
 
 def build_mesh(
@@ -82,6 +96,24 @@ def build_mesh(
     devices = list(devices if devices is not None else jax.devices())
     spec = MeshSpec.resolve(cfg, len(devices))
     shape = tuple(spec.axis_sizes()[a] for a in AXIS_ORDER)
+    if spec.dcn_data > 1:
+        # Multi-slice: per-axis ICI shape × per-axis DCN shape. The hybrid
+        # constructor groups devices by their slice_index so only the
+        # dcn_data axis crosses slice boundaries.
+        if getattr(devices[0], "slice_index", None) is None:
+            # Simulated CPU devices carry no slice_index; contiguous
+            # blocks of the device list stand in for slices. On real
+            # hardware this path must NOT be taken — a naive reshape would
+            # route "intra-slice" collectives over DCN silently.
+            dev_array = np.asarray(devices).reshape(shape)
+            return Mesh(dev_array, AXIS_ORDER)
+        ici = tuple(1 if a == "dcn_data" else spec.axis_sizes()[a]
+                    for a in AXIS_ORDER)
+        dcn = tuple(spec.dcn_data if a == "dcn_data" else 1
+                    for a in AXIS_ORDER)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici, dcn, devices=devices)
+        return Mesh(dev_array, AXIS_ORDER)
     try:
         dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
     except (ValueError, AssertionError, NotImplementedError):
@@ -90,28 +122,34 @@ def build_mesh(
     return Mesh(dev_array, AXIS_ORDER)
 
 
+def data_axis_size(mesh: Mesh) -> int:
+    """Total batch-sharding ways: the 'data' axis times the cross-slice
+    'dcn_data' axis (1 on single-slice meshes)."""
+    return mesh.shape["data"] * mesh.shape.get("dcn_data", 1)
+
+
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:
     """Per-process batch size: the global batch divided across the processes
-    that feed the 'data' axis. Each host feeds only its addressable shard —
+    that feed the data axes. Each host feeds only its addressable shard —
     the TPU equivalent of Horovod's per-rank batch."""
     n_proc = jax.process_count()
     if global_batch % n_proc != 0:
         raise ValueError(
             f"global batch {global_batch} not divisible by process count {n_proc}"
         )
-    if global_batch % mesh.shape["data"] != 0:
+    if global_batch % data_axis_size(mesh) != 0:
         raise ValueError(
             f"global batch {global_batch} not divisible by data-axis size "
-            f"{mesh.shape['data']}"
+            f"{data_axis_size(mesh)}"
         )
     return global_batch // n_proc
 
 
 def validate_batch(global_batch: int, mesh: Mesh) -> None:
-    if global_batch % mesh.shape["data"] != 0:
+    if global_batch % data_axis_size(mesh) != 0:
         raise ValueError(
-            f"global batch {global_batch} must be divisible by the data axis "
-            f"({mesh.shape['data']})"
+            f"global batch {global_batch} must be divisible by the total "
+            f"data-parallel ways ({data_axis_size(mesh)})"
         )
 
 

@@ -17,6 +17,8 @@ from typing import Any, Optional, Sequence, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .mesh import BATCH_AXES
+
 PyTree = Any
 
 # Rules mapping flattened param-path regexes → PartitionSpec, applied first
@@ -35,10 +37,14 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def batch_sharding(mesh: Mesh, ndim: int, spatial_dim: Optional[int] = None) -> NamedSharding:
-    """Batch tensors: dim 0 over 'data'; optionally one spatial dim over
-    'spatial' (Mask R-CNN's data+spatial shard)."""
+    """Batch tensors: dim 0 over the data axes — ('dcn_data', 'data')
+    jointly on multi-slice meshes, plain 'data' otherwise — optionally one
+    spatial dim over 'spatial' (Mask R-CNN's data+spatial shard)."""
     spec: list = [None] * ndim
-    spec[0] = "data"
+    if mesh.shape.get("dcn_data", 1) > 1:
+        spec[0] = BATCH_AXES
+    else:
+        spec[0] = "data"
     if spatial_dim is not None and mesh.shape.get("spatial", 1) > 1:
         spec[spatial_dim] = "spatial"
     return NamedSharding(mesh, P(*spec))

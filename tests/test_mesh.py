@@ -36,7 +36,7 @@ def test_meshspec_resolve_rejects_bad_shapes():
 
 def test_build_mesh_axes(devices):
     mesh = build_mesh(MeshConfig(data=-1, model=2, spatial=2))
-    assert mesh.shape == {"data": 2, "spatial": 2, "model": 2}
+    assert mesh.shape == {"dcn_data": 1, "data": 2, "spatial": 2, "model": 2}
     assert mesh.devices.size == 8
     assert "mesh[" in describe(mesh)
 
@@ -69,6 +69,34 @@ def test_param_rules_and_replication(devices):
     assert tree["dense"]["bias"].spec == P()
     placed = shard_params(params, mesh, rules)
     assert placed["dense"]["kernel"].addressable_shards[0].data.shape == (16, 4)
+
+
+def test_meshspec_resolve_multi_slice():
+    spec = MeshSpec.resolve(MeshConfig(data=-1, num_slices=2), 8)
+    assert spec.dcn_data == 2 and spec.data == 4 and spec.num_devices == 8
+    spec = MeshSpec.resolve(MeshConfig(data=-1, model=2, num_slices=2), 8)
+    assert spec.dcn_data == 2 and spec.data == 2 and spec.model == 2
+    with pytest.raises(ValueError):
+        MeshSpec.resolve(MeshConfig(num_slices=3), 8)  # 3 ∤ 8
+    with pytest.raises(ValueError):
+        # per-slice devices (8) not divisible by model*spatial (3)
+        MeshSpec.resolve(MeshConfig(model=3, num_slices=2), 16)
+
+
+def test_build_mesh_multi_slice(devices):
+    """2 simulated slices × 4 chips: the outer dcn_data axis spans slice
+    boundaries and the batch dim shards over both data axes jointly."""
+    mesh = build_mesh(MeshConfig(data=-1, num_slices=2))
+    assert mesh.shape == {"dcn_data": 2, "data": 4, "spatial": 1, "model": 1}
+    sh = batch_sharding(mesh, 2)
+    assert sh.spec == P(("dcn_data", "data"), None)
+    x = np.zeros((16, 4), np.float32)
+    sharded = jax.device_put(x, sh)
+    # 8 total data-parallel ways → 2 rows per device.
+    assert sharded.addressable_shards[0].data.shape == (2, 4)
+    # Params stay replicated across slices (full copy on every device).
+    tree = param_sharding_tree({"w": np.zeros((4, 4), np.float32)}, mesh)
+    assert tree["w"].spec == P()
 
 
 def test_validate_batch(devices):
