@@ -87,16 +87,14 @@ def run_bench(
     """Run ``steps`` timed train steps of ``preset`` on synthetic data and
     return the one-line JSON record the driver expects."""
     stage("import_jax")
-    import os
-
     import jax
 
-    # On this image a sitecustomize pre-registers the TPU PJRT plugin, and
-    # the env var alone does not stop its (hang-prone) init — the platform
-    # list must also be set in-process before first backend use. No-op when
-    # the env var is unset (real-chip runs).
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # No-op when JAX_PLATFORMS is unset (real-chip runs); otherwise applies
+    # it in-process — the env var alone is too late on images that
+    # pre-register a TPU plugin (see runtime/platform.py).
+    from .runtime.platform import honor_env_platform
+
+    honor_env_platform()
 
     stage("backend_init")  # first jax.devices() triggers PJRT client init
     devices = jax.devices()

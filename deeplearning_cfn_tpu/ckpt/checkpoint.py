@@ -180,11 +180,11 @@ def _garbage_collect(store: Store, keep: int):
 def _committed_steps(directory: StoreOrPath) -> List[int]:
     store = open_store(directory)
     out = []
-    for key in store.list(""):
-        parts = key.split("/")
-        if len(parts) == 2 and parts[0].startswith("step_") \
-                and parts[1] == _COMMIT:
-            out.append(int(parts[0][len("step_"):]))
+    # One-level listing + a COMMIT existence probe per step: O(steps),
+    # never a walk over every shard object of every retained checkpoint.
+    for name in store.list_subdirs(""):
+        if name.startswith("step_") and store.exists(f"{name}/{_COMMIT}"):
+            out.append(int(name[len("step_"):]))
     return out
 
 

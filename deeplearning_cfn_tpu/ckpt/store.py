@@ -52,6 +52,18 @@ class Store:
     def delete_prefix(self, prefix: str) -> None:
         raise NotImplementedError
 
+    def list_subdirs(self, prefix: str = "") -> List[str]:
+        """Immediate child 'directory' names under ``prefix`` — e.g. the
+        step_XXXXXXXX entries at the root. Default derives from a full
+        list(); POSIX/GCS override with one-level listings so per-commit
+        bookkeeping stays O(steps), not O(total objects)."""
+        out = set()
+        for k in self.list(prefix):
+            rest = k[len(prefix):]
+            if "/" in rest:
+                out.add(rest.split("/", 1)[0])
+        return sorted(out)
+
     # npz helpers: subclasses may override with streaming implementations.
 
     def put_npz(self, key: str, arrays: Dict[str, np.ndarray]) -> None:
@@ -124,6 +136,13 @@ class PosixStore(Store):
                 os.remove(self._path(key))
             except OSError:
                 pass
+
+    def list_subdirs(self, prefix: str = "") -> List[str]:
+        base = self._path(prefix.rstrip("/")) if prefix else self.root
+        if not os.path.isdir(base):
+            return []
+        return sorted(n for n in os.listdir(base)
+                      if os.path.isdir(os.path.join(base, n)))
 
     def put_npz(self, key: str, arrays: Dict[str, np.ndarray]) -> None:
         # Stream straight to disk instead of staging the whole npz in RAM.
@@ -225,6 +244,18 @@ class GcsStore(Store):
         full = self._blob_name(prefix)
         for blob in list(self._client.list_blobs(self._bucket, prefix=full)):
             blob.delete()
+
+    def list_subdirs(self, prefix: str = "") -> List[str]:
+        # Delimiter listing: one API page of "directories", not a full
+        # pagination over every shard object.
+        full = self._blob_name(prefix)
+        if full and not full.endswith("/"):
+            full += "/"
+        it = self._client.list_blobs(self._bucket, prefix=full,
+                                     delimiter="/")
+        list(it)  # drain to populate prefixes
+        start = len(full)
+        return sorted(p[start:].rstrip("/") for p in it.prefixes)
 
     def describe(self) -> str:
         return self.url

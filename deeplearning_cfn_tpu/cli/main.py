@@ -139,7 +139,11 @@ def _cmd_train(args) -> int:
     # Single-host path: run in-process, exactly like executing a reference
     # example script on one node.
     if cfg.stack.accelerator == "cpu":
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # Env var alone is too late on images that pre-register a TPU
+        # plugin — must also flip the platform in-process (platform.py).
+        from ..runtime.platform import force_cpu_platform
+
+        force_cpu_platform()
     from ..train.run import run_experiment
 
     final = run_experiment(cfg, max_steps=args.max_steps)

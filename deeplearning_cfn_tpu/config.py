@@ -41,10 +41,6 @@ class MeshConfig:
     spatial: int = 1
     num_slices: int = 1
 
-    def axis_sizes(self) -> Dict[str, int]:
-        return {"dcn_data": self.num_slices, "data": self.data,
-                "model": self.model, "spatial": self.spatial}
-
 
 @dataclasses.dataclass
 class OptimizerConfig:

@@ -101,10 +101,17 @@ def build_mesh(
         # constructor groups devices by their slice_index so only the
         # dcn_data axis crosses slice boundaries.
         if getattr(devices[0], "slice_index", None) is None:
-            # Simulated CPU devices carry no slice_index; contiguous
-            # blocks of the device list stand in for slices. On real
-            # hardware this path must NOT be taken — a naive reshape would
-            # route "intra-slice" collectives over DCN silently.
+            if getattr(devices[0], "platform", "") != "cpu":
+                # Accelerator devices without slice topology info: a naive
+                # reshape would silently route "intra-slice" collectives
+                # over DCN. Refuse rather than degrade.
+                raise ValueError(
+                    f"num_slices={spec.dcn_data} needs devices with "
+                    f"slice_index (multi-slice runtime); "
+                    f"{devices[0].platform} devices expose none"
+                )
+            # Simulated CPU devices: contiguous blocks of the device list
+            # stand in for slices.
             dev_array = np.asarray(devices).reshape(shape)
             return Mesh(dev_array, AXIS_ORDER)
         ici = tuple(1 if a == "dcn_data" else spec.axis_sizes()[a]

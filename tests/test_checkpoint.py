@@ -156,6 +156,8 @@ def test_store_interface_posix_and_memory(tmp_workdir):
         np.testing.assert_array_equal(z["w"], np.arange(4.0))
         z.close()
         assert sorted(store.list("a/")) == ["a/b/c.txt", "a/x.npz"]
+        assert store.list_subdirs("") == ["a"]
+        assert store.list_subdirs("a/") == ["b"]
         store.delete_prefix("a/b/")
         assert store.list("a/") == ["a/x.npz"]
         assert not store.exists("a/b/c.txt")

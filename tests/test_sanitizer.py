@@ -9,9 +9,9 @@ framework debug flags; here it is one marked pytest tier:
 
     pytest -m sanitizer
 
-Kept out of the default run (`-m "not sanitizer"` is NOT needed — these
-tests also pass normally, they are just slower under the checks), but the
-marker gives CI a dedicated job handle.
+The marker gives CI a dedicated job handle; the tests also run (and pass)
+as part of the plain suite — deselect with `-m "not sanitizer"` if the
+extra ~1 min matters.
 """
 
 import contextlib
