@@ -214,7 +214,8 @@ def _cmd_bench(args) -> int:
     from ..bench import run_bench
 
     line = run_bench(preset=args.preset, steps=args.steps,
-                     global_batch=args.global_batch)
+                     global_batch=args.global_batch,
+                     include_input=args.with_input)
     print(json.dumps(line))
     return 0
 
@@ -330,6 +331,9 @@ def build_parser() -> argparse.ArgumentParser:
     be.add_argument("--preset", default="cifar10_resnet20")
     be.add_argument("--steps", type=int, default=30)
     be.add_argument("--global-batch", type=int, default=0)
+    be.add_argument("--with-input", action="store_true",
+                    help="also report value_with_input (host pipeline + "
+                         "transfer in the timed loop)")
     be.set_defaults(fn=_cmd_bench)
 
     # data -------------------------------------------------------------------

@@ -126,6 +126,7 @@ class EvalConfig:
     beam_size: int = 4  # 1 = greedy
     length_penalty: float = 0.6
     max_decode_len: int = 0  # 0 = data.seq_len
+    use_kv_cache: bool = True  # cached O(T) decode vs full recompute
     # Detection inference (train/detection_task.py post-processing).
     detect_topk: int = 100  # fixed detections per image (COCO maxDets)
     detect_score_threshold: float = 0.05

@@ -79,6 +79,14 @@ class _Rng:
         return (self.next() >> 11) * (1.0 / 9007199254740992.0)
 
 
+# Eval center-crop field of view: crop EVAL_CROP_RATIO*min(h,w), then
+# resize — with 256² stored sources exactly the classic resize-256 /
+# center-crop-224 recipe, and the same field of view at any other shard
+# size. Must match kEvalCropRatio in dataio.cpp (same contract style as
+# the shared RNG).
+EVAL_CROP_RATIO = 0.875
+
+
 def _crop_params(rng: "_Rng", h: int, w: int, augment: bool
                  ) -> Tuple[int, int, int, int, bool]:
     """(y0, x0, crop_h, crop_w, flip) — the draw order is the contract
@@ -98,7 +106,9 @@ def _crop_params(rng: "_Rng", h: int, w: int, augment: bool
         side = min(h, w)
         return (h - side) // 2, (w - side) // 2, side, side, \
             bool(rng.next() & 1)
-    side = min(h, w)
+    # floor(x + 0.5): the one tie-breaking rule both implementations use
+    # (Python round() is half-to-even and would diverge from C++ lround).
+    side = max(1, int(EVAL_CROP_RATIO * min(h, w) + 0.5))
     return (h - side) // 2, (w - side) // 2, side, side, False
 
 

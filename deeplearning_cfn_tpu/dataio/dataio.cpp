@@ -162,9 +162,19 @@ static void crop_resize_one(int b, void* p) {
     }
     flip = (rng.next() & 1) != 0;
   } else {
-    // Eval: center crop of the shorter side (sources are pre-resized so
-    // this is the classic resize-256 / center-crop-224 recipe's tail).
-    ch = cw = H < W ? H : W;
+    // Eval: center crop at the EXPLICIT classic ratio — crop
+    // 0.875*min(H,W), then resize to the output. With 256^2 stored
+    // sources this is exactly resize-256 / center-crop-224; with any
+    // other shard size the field of view stays the same instead of
+    // silently widening. Constant must match data/imagenet.py
+    // EVAL_CROP_RATIO (same contract style as the shared RNG).
+    const double kEvalCropRatio = 0.875;
+    int side = H < W ? H : W;
+    // floor(x + 0.5): same tie-breaking as the Python fallback's
+    // int(ratio*side + 0.5) — lround would round .5 away from zero on
+    // some sizes where Python's round() goes half-to-even.
+    ch = cw = (int)(kEvalCropRatio * side + 0.5);
+    if (ch < 1) ch = cw = 1;
     y0 = (H - ch) / 2;
     x0 = (W - cw) / 2;
   }

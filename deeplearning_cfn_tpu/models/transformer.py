@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import Any, Callable
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -37,7 +38,16 @@ TRANSFORMER_PARAM_RULES = (
 
 
 class MultiHeadAttention(nn.Module):
-    """Self- or cross-attention over [B, S, H*D] activations."""
+    """Self- or cross-attention over [B, S, H*D] activations.
+
+    ``decode=True`` is the autoregressive single-position mode: ``x`` is
+    [B, 1, F], and this step's K/V are appended into a ``cache`` collection
+    (``cached_key``/``cached_value`` [B, H, max_decode_len, D] plus a
+    ``cache_index`` scalar) so attention touches only projected-once keys —
+    the KV-cache that turns O(T²) decode recompute into O(T). Create the
+    cache by running ``model.init`` on the decode path and keep the
+    returned "cache" collection as scan carry (flax's standard pattern).
+    """
 
     num_heads: int
     dtype: Dtype = jnp.bfloat16
@@ -46,7 +56,9 @@ class MultiHeadAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, kv=None, bias=None, causal=False,
-                 deterministic=True):
+                 deterministic=True, decode=False,
+                 max_decode_len: int = 0):
+        self_attention = kv is None
         kv = x if kv is None else kv
         features = x.shape[-1]
         if features % self.num_heads:
@@ -66,8 +78,39 @@ class MultiHeadAttention(nn.Module):
         q = split(dense("query")(x))
         k = split(dense("key")(kv))
         v = split(dense("value")(kv))
-        out = fused_attention(q, k, v, bias=bias, causal=causal,
-                              implementation=self.attention_impl)
+        if decode and self_attention:
+            if max_decode_len <= 0:
+                raise ValueError("decode=True needs max_decode_len")
+            b = q.shape[0]
+            shape = (b, self.num_heads, max_decode_len, head_dim)
+            # Standard flax guard: during init (cache vars not yet created)
+            # only allocate — running the update there would leave the
+            # returned cache pre-advanced by one garbage position.
+            is_initialized = self.has_variable("cache", "cached_key")
+            ck = self.variable("cache", "cached_key",
+                               lambda: jnp.zeros(shape, self.dtype))
+            cv = self.variable("cache", "cached_value",
+                               lambda: jnp.zeros(shape, self.dtype))
+            ci = self.variable("cache", "cache_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            idx = ci.value
+            if is_initialized:
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k.astype(self.dtype), (0, 0, idx, 0))
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v.astype(self.dtype), (0, 0, idx, 0))
+                ci.value = idx + 1
+            # Attend only to filled positions (<= idx). The single-query
+            # step is tiny — the jnp reference path, not the Pallas
+            # kernel, is the right tool.
+            step_bias = jnp.where(
+                jnp.arange(max_decode_len) <= idx, 0.0, -1e30
+            )[None, None, None, :].astype(jnp.float32)
+            out = fused_attention(q, ck.value, cv.value, bias=step_bias,
+                                  causal=False, implementation="reference")
+        else:
+            out = fused_attention(q, k, v, bias=bias, causal=causal,
+                                  implementation=self.attention_impl)
         b, h, s, d = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
         out = dense("attn_out")(out)
@@ -115,7 +158,8 @@ class TransformerLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, enc=None, self_bias=None, cross_bias=None,
-                 causal=False, deterministic=True):
+                 causal=False, deterministic=True, decode=False,
+                 max_decode_len: int = 0):
         ln = lambda name: nn.LayerNorm(
             dtype=self.dtype, param_dtype=jnp.float32, name=name)
         attn = lambda name: MultiHeadAttention(
@@ -127,10 +171,15 @@ class TransformerLayer(nn.Module):
                 return x + sub(ln(f"{name}_norm")(x))
             return ln(f"{name}_norm")(x + sub(x))
 
+        # decode mode: the self-attention runs single-position against its
+        # KV cache (causal masking is implied by the cache index); cross
+        # attention recomputes enc K/V per step — caching those too is a
+        # constant-factor optimization, not an asymptotic one.
         x = residual(
             x, lambda y: attn("self_attn")(
-                y, bias=self_bias, causal=causal,
-                deterministic=deterministic),
+                y, bias=self_bias, causal=causal and not decode,
+                deterministic=deterministic, decode=decode,
+                max_decode_len=max_decode_len),
             "self_attn")
         if self.cross_attention:
             if enc is None:

@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from . import register_model
@@ -119,6 +120,28 @@ class TransformerNMT(nn.Module):
         for lyr in self.dec:
             y = lyr(y, enc=enc, cross_bias=cross_bias, causal=True,
                     deterministic=det)
+        y = self.dec_norm(y)
+        return self.embed.logits(y)
+
+    def decode_step(self, tgt_id, enc, src_mask, pos):
+        """Single-position autoregressive decode with KV caches.
+
+        ``tgt_id`` [B, 1] is the token at position ``pos`` (BOS for pos 0);
+        returns logits [B, 1, V] for position ``pos + 1``. Each decoder
+        layer's self-attention appends this position's K/V into the
+        "cache" collection (see transformer.MultiHeadAttention) — create
+        the cache with ``model.init(..., method=TransformerNMT.decode_step)``
+        and thread it through the scan as carry (models/decoding.py does).
+        """
+        pos_emb = jax.lax.dynamic_slice(
+            self.embed.tgt_position, (pos, 0), (1, self.hidden_size))
+        y = self.embed.token(tgt_id) + pos_emb[None, :, :]
+        y = self.embed.tgt_norm(y.astype(self.dtype))
+        cross_bias = padding_bias(src_mask)
+        for lyr in self.dec:
+            y = lyr(y, enc=enc, cross_bias=cross_bias, causal=True,
+                    deterministic=True, decode=True,
+                    max_decode_len=self.max_len)
         y = self.dec_norm(y)
         return self.embed.logits(y)
 

@@ -247,20 +247,32 @@ class Seq2SeqTask:
         the decoded ids so every process scores the full eval set.
         """
         from ..metrics.bleu import corpus_bleu
-        from ..models.decoding import beam_decode, greedy_decode, \
-            strip_special
+        from ..models import decoding
+        from ..models.decoding import strip_special
 
         ev = self.cfg.eval
         if not ev.enabled:
             return {}
         max_len = ev.max_decode_len or self.cfg.data.seq_len
+        model_max = getattr(self.model, "max_len", None)
+        if model_max is not None and max_len > model_max:
+            # The cached path's cache (and the position table) are sized
+            # model.max_len; past it, clamped dynamic slices would decode
+            # garbage silently. Fail loudly where the configs meet.
+            raise ValueError(
+                f"eval decode length {max_len} exceeds the model's "
+                f"max_len {model_max}")
         variables = {"params": eval_params(state)}
 
+        greedy = decoding.greedy_decode_cached if ev.use_kv_cache \
+            else decoding.greedy_decode
+        beam = decoding.beam_decode_cached if ev.use_kv_cache \
+            else decoding.beam_decode
         if ev.beam_size <= 1:
-            decode = jax.jit(lambda v, src, mask: greedy_decode(
+            decode = jax.jit(lambda v, src, mask: greedy(
                 self.model, v, src, mask, max_len))
         else:
-            decode = jax.jit(lambda v, src, mask: beam_decode(
+            decode = jax.jit(lambda v, src, mask: beam(
                 self.model, v, src, mask, max_len, ev.beam_size,
                 ev.length_penalty)[0])
 

@@ -24,7 +24,9 @@ from deeplearning_cfn_tpu.models.decoding import (
     EOS_ID,
     PAD_ID,
     beam_decode,
+    beam_decode_cached,
     greedy_decode,
+    greedy_decode_cached,
     strip_special,
 )
 from deeplearning_cfn_tpu.models.transformer_nmt import TransformerNMT
@@ -273,6 +275,32 @@ def test_beam_matches_brute_force(tiny_nmt, tiny_src, w):
                                       mask[i:i + 1], w, 0.6)
         assert toks[i].tolist() == e_toks, (i, toks[i], e_toks)
         assert scores[i] == pytest.approx(e_score, abs=1e-4)
+
+
+def test_cached_greedy_matches_recompute(tiny_nmt, tiny_src):
+    """The KV-cached decode path must produce bit-identical token streams
+    to the full-recompute path — same params, same inputs."""
+    model, variables = tiny_nmt
+    src, mask = tiny_src
+    a = np.asarray(greedy_decode(model, variables, src, mask, MAXLEN))
+    b = np.asarray(greedy_decode_cached(model, variables, src, mask,
+                                        MAXLEN))
+    np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("w", [2, 3])
+def test_cached_beam_matches_recompute(tiny_nmt, tiny_src, w):
+    """Beam + cache: the per-step cache reorder must track surviving beams
+    exactly — any ancestry mix-up shows up as diverging tokens/scores."""
+    model, variables = tiny_nmt
+    src, mask = tiny_src
+    t_a, s_a = beam_decode(model, variables, src, mask, MAXLEN,
+                           beam_size=w, length_penalty=0.6)
+    t_b, s_b = beam_decode_cached(model, variables, src, mask, MAXLEN,
+                                  beam_size=w, length_penalty=0.6)
+    np.testing.assert_array_equal(np.asarray(t_a), np.asarray(t_b))
+    np.testing.assert_allclose(np.asarray(s_a), np.asarray(s_b),
+                               rtol=1e-5, atol=1e-5)
 
 
 def test_strip_special():

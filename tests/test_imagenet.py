@@ -39,14 +39,20 @@ def test_shard_roundtrip_multi_shard(tmp_path):
     with open(os.path.join(out, "index.json")) as fh:
         index = json.load(fh)
     assert len(index["shards"]) == 3  # 16 + 16 + 8
-    src = ShardedImageNetSource(out, train=False, image_size=48,
+    # Explicit eval-crop contract: the center crop takes
+    # EVAL_CROP_RATIO * min(h, w) regardless of shard size. At
+    # image_size == round(0.875 * 48) == 42 the resize is identity, so
+    # the output must be exactly the normalized central 42² window (the
+    # classic resize-256/crop-224 recipe generalized).
+    crop = round(0.875 * 48)
+    src = ShardedImageNetSource(out, train=False, image_size=crop,
                                 native=False)
     assert src.size == 40
     np.testing.assert_array_equal(src._labels, labels.astype(np.int32))
-    # Center crop of a square source at source size == identity (up to the
-    # normalize transform).
     batch = src.gather_seeded(np.asarray([7]), seed=123)
-    expect = (images[7].astype(np.float32) / 255.0 -
+    lo = (48 - crop) // 2
+    window = images[7][lo:lo + crop, lo:lo + crop]
+    expect = (window.astype(np.float32) / 255.0 -
               IMAGENET_MEAN) / IMAGENET_STD
     np.testing.assert_allclose(batch["image"][0], expect, atol=1e-4)
     assert batch["label"][0] == labels[7]
@@ -89,6 +95,30 @@ def test_native_python_parity(tmp_path, train):
     b = fallback.gather_seeded(idx, seed=7)
     np.testing.assert_allclose(a["image"], b["image"], atol=1e-4)
     np.testing.assert_array_equal(a["label"], b["label"])
+
+
+def test_eval_crop_rounding_parity_at_tie_size(tmp_path):
+    """0.875 * 44 = 38.5 — a rounding tie. The C++ kernel and the numpy
+    fallback must break it identically (floor(x+0.5) → 38); Python's
+    half-to-even round() would give 38 while lround gives 39, so this size
+    pins the shared tie-breaking rule."""
+    from deeplearning_cfn_tpu import dataio
+    from deeplearning_cfn_tpu.data.imagenet import (
+        IMAGENET_MEAN,
+        IMAGENET_STD,
+        _crop_resize_norm_py,
+    )
+
+    if dataio.get_lib() is None:
+        pytest.skip("native dataio unavailable")
+    rng = np.random.RandomState(11)
+    img = rng.randint(0, 256, (44, 44, 3), np.uint8)
+    img = np.ascontiguousarray(img)
+    ptrs = np.asarray([img.ctypes.data], np.uint64)
+    a = dataio.crop_resize_norm(ptrs, (44, 44), 32, seed=5, augment=False,
+                                mean=IMAGENET_MEAN, std=IMAGENET_STD)
+    b = _crop_resize_norm_py([img], 32, seed=5, augment=False)
+    np.testing.assert_allclose(a, b, atol=1e-4)
 
 
 def test_pipeline_integration_epoch_coverage(tmp_path):
