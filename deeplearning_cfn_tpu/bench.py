@@ -222,15 +222,18 @@ def run_bench(
                                    cfg.model.num_classes, seed=1,
                                    train=True)
         it = feed_pipe.epochs()
-        state, m = compiled_step(state, trainer.device_batch(next(it)),
-                                 step_rng)
-        float(m["loss"])
-        t0 = time.perf_counter()
-        for _ in range(steps):
+        try:
             state, m = compiled_step(state, trainer.device_batch(next(it)),
                                      step_rng)
-        float(m["loss"])
-        step_s = (time.perf_counter() - t0) / steps
+            float(m["loss"])
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, m = compiled_step(
+                    state, trainer.device_batch(next(it)), step_rng)
+            float(m["loss"])
+            step_s = (time.perf_counter() - t0) / steps
+        finally:
+            it.close()  # stop the prefetch worker, release its buffers
         record["value_with_input"] = round(gb / step_s / n_chips, 2)
         record["mean_step_s_with_input"] = round(step_s, 5)
 

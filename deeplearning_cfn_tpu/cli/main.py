@@ -211,6 +211,17 @@ def _train_on_stack(args, cfg: ExperimentConfig) -> int:
 
 
 def _cmd_bench(args) -> int:
+    if args.collectives:
+        # The nccl-tests role: psum/all-gather/ppermute/reduce-scatter bus
+        # bandwidth over the mesh's links, one JSON line per op.
+        from ..parallel.collectives_bench import run_collectives_bench
+        from ..runtime.platform import honor_env_platform
+
+        honor_env_platform()  # env var alone is too late on this image
+
+        for rec in run_collectives_bench(size_mb=args.size_mb):
+            print(json.dumps(rec))
+        return 0
     from ..bench import run_bench
 
     line = run_bench(preset=args.preset, steps=args.steps,
@@ -334,6 +345,11 @@ def build_parser() -> argparse.ArgumentParser:
     be.add_argument("--with-input", action="store_true",
                     help="also report value_with_input (host pipeline + "
                          "transfer in the timed loop)")
+    be.add_argument("--collectives", action="store_true",
+                    help="run the collectives microbench (nccl-tests role) "
+                         "instead of a training-step bench")
+    be.add_argument("--size-mb", type=float, default=64.0,
+                    help="collectives payload size in MB")
     be.set_defaults(fn=_cmd_bench)
 
     # data -------------------------------------------------------------------

@@ -261,27 +261,51 @@ class DataPipeline:
 
 
 def _thread_prefetch(it: Iterator[Batch], depth: int) -> Iterator[Batch]:
+    """Background-thread prefetch with a shutdown path: closing (or
+    abandoning + GC'ing) the returned generator stops the worker and drains
+    the queue, so no thread is left blocked on a full queue pinning
+    ``depth + 1`` materialized batches for the rest of the process."""
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     _SENTINEL = object()
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker():
         try:
             for item in it:
-                q.put(item)
-            q.put(_SENTINEL)
+                if not put(item):
+                    return
+            put(_SENTINEL)
         except BaseException as e:  # propagate loader crashes to consumer
-            q.put(("__prefetch_error__", e))
+            put(("__prefetch_error__", e))
 
     t = threading.Thread(target=worker, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _SENTINEL:
-            return
-        if isinstance(item, tuple) and len(item) == 2 and \
-                item[0] == "__prefetch_error__":
-            raise RuntimeError("data pipeline worker crashed") from item[1]
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                return
+            if isinstance(item, tuple) and len(item) == 2 and \
+                    item[0] == "__prefetch_error__":
+                raise RuntimeError("data pipeline worker crashed") \
+                    from item[1]
+            yield item
+    finally:
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
 
 
 # ---------------------------------------------------------------------------

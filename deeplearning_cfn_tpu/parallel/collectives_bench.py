@@ -55,7 +55,9 @@ def run_collectives_bench(
     n = mesh.shape[axis]
     ops = ops or ["psum", "all_gather", "psum_scatter", "ppermute"]
     elems = int(size_mb * 1e6 / 4)
-    elems = max(n, elems - elems % n)  # divisible for scatter/gather
+    # Divisible by n² : the global buffer shards n ways, and reduce-scatter
+    # splits each rank's LOCAL shard n ways again.
+    elems = max(n * n, elems - elems % (n * n))
     results = []
     spec = P(axis)
     x = jax.device_put(

@@ -182,6 +182,23 @@ class Trainer:
         last_realized: Optional[Dict[str, float]] = None
         gb = self.cfg.train.global_batch
 
+        try:
+            return self._fit_loop(
+                state, train_iter, num_steps, rng, eval_iter_fn, eval_every,
+                eval_steps, hooks, log_every, metrics_writer, step,
+                window_start, window_examples, last, last_realized, gb)
+        finally:
+            # Stop a prefetched iterator's worker thread (and free its
+            # buffered batches) instead of abandoning it blocked on a full
+            # queue for the rest of the process.
+            close = getattr(train_iter, "close", None)
+            if close is not None:
+                close()
+
+    def _fit_loop(self, state, train_iter, num_steps, rng, eval_iter_fn,
+                  eval_every, eval_steps, hooks, log_every, metrics_writer,
+                  step, window_start, window_examples, last, last_realized,
+                  gb):
         while step < num_steps:
             batch = next(train_iter)
             dev_batch = self.device_batch(batch)

@@ -32,6 +32,19 @@ def test_config_rejects_unknown_override():
         main(["config", "--preset", "cifar10_resnet20", "train.nope=1"])
 
 
+def test_bench_collectives_verb(capsys, devices):
+    """`bench --collectives` is the nccl-tests role: one JSON record per
+    collective with a positive bus bandwidth over the 8-device mesh."""
+    assert main(["bench", "--collectives", "--size-mb", "2"]) == 0
+    lines = [json.loads(l) for l in
+             capsys.readouterr().out.strip().splitlines()]
+    assert {r["op"] for r in lines} == \
+        {"psum", "all_gather", "psum_scatter", "ppermute"}
+    for r in lines:
+        assert r["ranks"] == 8
+        assert r["busbw_gbps"] > 0
+
+
 def test_stack_lifecycle(tmp_path, capsys):
     state_dir = str(tmp_path)
     assert main(["stack", "create", "--name", "clitest",

@@ -99,9 +99,9 @@ def test_native_python_parity(tmp_path, train):
 
 def test_eval_crop_rounding_parity_at_tie_size(tmp_path):
     """0.875 * 44 = 38.5 — a rounding tie. The C++ kernel and the numpy
-    fallback must break it identically (floor(x+0.5) → 38); Python's
-    half-to-even round() would give 38 while lround gives 39, so this size
-    pins the shared tie-breaking rule."""
+    fallback must break it identically: the shared rule is floor(x+0.5),
+    giving 39. Python's half-to-even round() would give 38 and silently
+    diverge from the C++ side, so this size pins the contract."""
     from deeplearning_cfn_tpu import dataio
     from deeplearning_cfn_tpu.data.imagenet import (
         IMAGENET_MEAN,
