@@ -11,10 +11,13 @@ numerics oracle in tests and the fallback on non-TPU backends.
 
 from .attention import attention_reference, fused_attention
 from .ring_attention import ring_attention, ring_attention_sharded
+from .ulysses import ulysses_attention, ulysses_attention_sharded
 
 __all__ = [
     "attention_reference",
     "fused_attention",
     "ring_attention",
     "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
 ]

@@ -182,67 +182,62 @@ class Trainer:
         last_realized: Optional[Dict[str, float]] = None
         gb = self.cfg.train.global_batch
 
+        # finally: stop a prefetched iterator's worker thread (and free its
+        # buffered batches) instead of abandoning it blocked on a full
+        # queue for the rest of the process.
         try:
-            return self._fit_loop(
-                state, train_iter, num_steps, rng, eval_iter_fn, eval_every,
-                eval_steps, hooks, log_every, metrics_writer, step,
-                window_start, window_examples, last, last_realized, gb)
+            while step < num_steps:
+                batch = next(train_iter)
+                dev_batch = self.device_batch(batch)
+                state, metrics = self.train_step(state, dev_batch, rng)
+                last = (step, metrics)
+                window_examples += gb
+                step += 1
+
+                if step % max(log_every, 1) == 0 or step >= num_steps:
+                    # Sync point: realize the latest step's metrics.
+                    last_step, last_metrics = last
+                    realized = {
+                        k: float(v) for k, v in
+                        jax.device_get(last_metrics).items()
+                    }
+                    elapsed = time.perf_counter() - window_start
+                    realized["examples_per_sec"] = \
+                        window_examples / max(elapsed, 1e-9)
+                    realized["examples_per_sec_per_device"] = (
+                        realized["examples_per_sec"] / self.mesh.devices.size
+                    )
+                    realized["step"] = last_step + 1
+                    if metrics_writer is not None:
+                        metrics_writer.write(realized)
+                    window_start = time.perf_counter()
+                    window_examples = 0
+                    last_realized = realized
+
+                # Hooks run every step (checkpoint cadence must not couple
+                # to log cadence); metrics arg is the last realized window,
+                # if any.
+                for hook in hooks:
+                    hook(step, state, last_realized)
+
+                if (
+                    eval_iter_fn is not None
+                    and eval_every > 0
+                    and step % eval_every == 0
+                ):
+                    eval_metrics = self.evaluate(state, eval_iter_fn(),
+                                                 eval_steps)
+                    if metrics_writer is not None:
+                        metrics_writer.write(
+                            {"step": step, **{f"eval_{k}": v
+                                              for k, v in
+                                              eval_metrics.items()}}
+                        )
+            return state
         finally:
-            # Stop a prefetched iterator's worker thread (and free its
-            # buffered batches) instead of abandoning it blocked on a full
-            # queue for the rest of the process.
             close = getattr(train_iter, "close", None)
             if close is not None:
                 close()
-
-    def _fit_loop(self, state, train_iter, num_steps, rng, eval_iter_fn,
-                  eval_every, eval_steps, hooks, log_every, metrics_writer,
-                  step, window_start, window_examples, last, last_realized,
-                  gb):
-        while step < num_steps:
-            batch = next(train_iter)
-            dev_batch = self.device_batch(batch)
-            state, metrics = self.train_step(state, dev_batch, rng)
-            last = (step, metrics)
-            window_examples += gb
-            step += 1
-
-            if step % max(log_every, 1) == 0 or step >= num_steps:
-                # Sync point: realize the latest step's metrics.
-                last_step, last_metrics = last
-                realized = {
-                    k: float(v) for k, v in
-                    jax.device_get(last_metrics).items()
-                }
-                elapsed = time.perf_counter() - window_start
-                realized["examples_per_sec"] = window_examples / max(elapsed, 1e-9)
-                realized["examples_per_sec_per_device"] = (
-                    realized["examples_per_sec"] / self.mesh.devices.size
-                )
-                realized["step"] = last_step + 1
-                if metrics_writer is not None:
-                    metrics_writer.write(realized)
-                window_start = time.perf_counter()
-                window_examples = 0
-                last_realized = realized
-
-            # Hooks run every step (checkpoint cadence must not couple to
-            # log cadence); metrics arg is the last realized window, if any.
-            for hook in hooks:
-                hook(step, state, last_realized)
-
-            if (
-                eval_iter_fn is not None
-                and eval_every > 0
-                and step % eval_every == 0
-            ):
-                eval_metrics = self.evaluate(state, eval_iter_fn(), eval_steps)
-                if metrics_writer is not None:
-                    metrics_writer.write(
-                        {"step": step, **{f"eval_{k}": v
-                                          for k, v in eval_metrics.items()}}
-                    )
-        return state
 
     def evaluate(self, state: TrainState, eval_iter: Iterator[Batch],
                  max_steps: int = 0) -> Dict[str, float]:

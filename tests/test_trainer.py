@@ -200,6 +200,24 @@ def test_checkpoint_cadence_decoupled_from_log_cadence(tmp_workdir, devices):
     assert "step_00000004" in ckpts and "step_00000008" in ckpts, ckpts
 
 
+def test_training_run_deterministic(tmp_workdir, devices):
+    """SURVEY §5.3's step-numerics golden test in self-consistent form: two
+    fresh runs with the same seed produce bit-identical loss trajectories
+    (data order, augmentation, init, and the compiled step are all
+    deterministic — the reproducibility the reference never had)."""
+    trajectories = []
+    for run in ("a", "b"):
+        cfg = _tiny_cfg(os.path.join(tmp_workdir, run), steps=8)
+        apply_overrides(cfg, ["train.log_every_steps=1"])
+        run_experiment(cfg)
+        path = os.path.join(tmp_workdir, run, "cifar10_resnet20",
+                            "metrics.jsonl")
+        trajectories.append([r["loss"] for r in read_metrics(path)
+                             if "loss" in r])
+    assert len(trajectories[0]) == 8
+    assert trajectories[0] == trajectories[1], trajectories
+
+
 def test_remat_flag_trains(tmp_workdir, devices):
     cfg = _tiny_cfg(tmp_workdir, steps=2)
     apply_overrides(cfg, ["train.remat=true"])
