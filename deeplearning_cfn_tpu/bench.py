@@ -146,7 +146,8 @@ def run_bench(
     sched = build_schedule(cfg.schedule, max(steps * 10, 1000), gb, 100)
     tx = build_optimizer(cfg.optimizer, sched)
     state = create_train_state(jax.random.PRNGKey(0), task.init, tx, mesh,
-                               param_rules=getattr(task, "param_rules", ()))
+                               param_rules=getattr(task, "param_rules", ()),
+                               shard_opt_state=cfg.train.shard_opt_state)
     trainer = Trainer(cfg, task.loss_fn, tx, mesh=mesh,
                       spatial_dim=getattr(task, "spatial_dim", None),
                       spatial_keys=getattr(task, "spatial_keys", None))

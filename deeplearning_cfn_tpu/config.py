@@ -84,6 +84,10 @@ class TrainConfig:
     seed: int = 0
     dtype: str = "bfloat16"  # compute dtype; params stay f32
     remat: bool = False  # jax.checkpoint the model apply
+    # ZeRO-1: shard param-mirroring optimizer slots over the 'data' axis
+    # (params/grads stay replicated; updates bit-identical — see
+    # train/state.py). Big win for Adam/LAMB-family state at pod scale.
+    shard_opt_state: bool = False
     label_smoothing: float = 0.0
     ema_decay: float = 0.0  # 0 = off
 

@@ -113,7 +113,8 @@ def _bert_base() -> ExperimentConfig:
             ),
         ),
         data=DataConfig(name="wikipedia_mlm", seq_len=128, vocab_size=30522),
-        train=TrainConfig(global_batch=1024, steps=100_000, dtype="bfloat16"),
+        train=TrainConfig(global_batch=1024, steps=100_000, dtype="bfloat16",
+                          shard_opt_state=True),  # ZeRO-1: LAMB slots /N
         optimizer=OptimizerConfig(name="lamb", weight_decay=0.01,
                                   grad_clip_norm=1.0),
         schedule=ScheduleConfig(name="cosine", base_lr=1e-3, warmup_steps=3000),

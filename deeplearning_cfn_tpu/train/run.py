@@ -63,6 +63,7 @@ def run_experiment(
         init_rng, task.init, tx, mesh,
         param_rules=getattr(task, "param_rules", ()),
         ema=cfg.train.ema_decay > 0,
+        shard_opt_state=cfg.train.shard_opt_state,
     )
 
     workdir = os.path.join(cfg.workdir, cfg.preset or cfg.model.name)

@@ -15,7 +15,7 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.sharding import param_sharding_tree, replicated
 
@@ -52,6 +52,7 @@ def create_train_state(
     mesh: Mesh,
     param_rules=(),
     ema: bool = False,
+    shard_opt_state: bool = False,
 ) -> TrainState:
     """Initialize state directly into its sharded layout.
 
@@ -59,6 +60,15 @@ def create_train_state(
     Init runs under jit with output shardings derived from the param rules so
     large models never materialize unsharded on one device — the TPU
     replacement for "rank 0 inits then broadcasts".
+
+    ``shard_opt_state=True`` is the ZeRO-1 layout: params and grads stay
+    replicated (pure DP semantics, bit-identical updates), but every
+    param-mirroring optimizer slot (momentum, mu/nu, LAMB stats) shards one
+    divisible dim over the 'data' axis. GSPMD then partitions the
+    elementwise optimizer update across the axis and all-gathers only the
+    parameter updates — optimizer memory drops by the data-parallel ways
+    (at BERT-base/LAMB scale: 2 × 440 MB of slots → ~14 MB/chip on 64
+    chips) for one extra collective per step.
     """
     var_shapes = jax.eval_shape(init_fn, rng)
     params_shape = var_shapes["params"]
@@ -82,26 +92,57 @@ def create_train_state(
     state_shapes = jax.eval_shape(make_state, rng)
 
     # Sharding tree: params + ema follow the rules; opt_state slots that
-    # mirror params inherit their sharding; everything else replicated.
+    # mirror params inherit their sharding (plus the ZeRO-1 data-axis shard
+    # when enabled); everything else replicated.
     out_sh = TrainState(
         step=replicated(mesh),
         params=param_sh,
         batch_stats=stats_sh,
         opt_state=_opt_state_shardings(state_shapes.opt_state, params_shape,
-                                       param_sh, mesh),
+                                       param_sh, mesh,
+                                       zero1=shard_opt_state),
         ema_params=param_sh if ema else None,
     )
     make_sharded = jax.jit(make_state, out_shardings=out_sh)
     return make_sharded(rng)
 
 
-def _opt_state_shardings(opt_state_shape, params_shape, param_sh, mesh):
+def _zero1_spec(shape, base_sharding, mesh):
+    """Extend a mirror slot's sharding with a 'data'-axis shard on the
+    first dim that is unsharded and divisible; leave the rest alone (a TP
+    'model' shard on another dim composes). Slots whose spec already uses
+    'data' (e.g. an FSDP-style param rule) are left untouched — a mesh
+    axis may appear only once per spec."""
+    ways = mesh.shape.get("data", 1)
+    if ways <= 1 or not shape:
+        return base_sharding
+    spec = list(base_sharding.spec) + \
+        [None] * (len(shape) - len(base_sharding.spec))
+    used = [a for s in spec for a in
+            (s if isinstance(s, tuple) else (s,)) if a is not None]
+    if "data" in used:
+        return base_sharding
+    for dim, size in enumerate(shape):
+        if spec[dim] is None and size % ways == 0:
+            spec[dim] = "data"
+            return NamedSharding(mesh, P(*spec))
+    return base_sharding  # nothing divisible: stays as-is
+
+
+def _opt_state_shardings(opt_state_shape, params_shape, param_sh, mesh,
+                         zero1: bool = False):
     """Optimizer slots that mirror a param (momentum, mu/nu) inherit its
     sharding; scalars/counters are replicated. Matched structurally: any
     subtree of opt_state whose treedef equals the param treedef gets param
     shardings."""
     params_def = jax.tree_util.tree_structure(params_shape)
     param_sh_leaves = jax.tree_util.tree_leaves(param_sh)
+    if zero1:
+        shape_leaves = jax.tree_util.tree_leaves(params_shape)
+        param_sh_leaves = [
+            _zero1_spec(tuple(s.shape), sh, mesh)
+            for s, sh in zip(shape_leaves, param_sh_leaves)
+        ]
 
     def assign(node):
         try:
