@@ -84,6 +84,10 @@ class TrainConfig:
     seed: int = 0
     dtype: str = "bfloat16"  # compute dtype; params stay f32
     remat: bool = False  # jax.checkpoint the model apply
+    # Capture a device+host profiler trace of this many hot-loop steps
+    # (starting after the compile step) to <workdir>/<preset>/profile —
+    # the Horovod-timeline role, natively. 0 = off.
+    profile_steps: int = 0
     # ZeRO-1: shard param-mirroring optimizer slots over the 'data' axis
     # (params/grads stay replicated; updates bit-identical — see
     # train/state.py). Big win for Adam/LAMB-family state at pod scale.

@@ -105,6 +105,9 @@ def run_experiment(
         hooks=(ckpt_hook,),
         log_every=cfg.train.log_every_steps,
         metrics_writer=writer,
+        trace_dir=os.path.join(workdir, "profile")
+        if cfg.train.profile_steps > 0 else None,
+        trace_steps=cfg.train.profile_steps,
     )
     manager.save(int(state.step), state, force=True)
     manager.wait()

@@ -14,6 +14,7 @@ input pipeline overlaps device compute.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
@@ -31,6 +32,38 @@ from .state import TrainState
 PyTree = Any
 Batch = Dict[str, np.ndarray]
 LossFn = Callable[..., Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]]
+
+
+class _LazyShardedJit:
+    """jit the train step with ``out_shardings`` pinned to the INPUT
+    state's layout (captured at first call, when concrete arrays with
+    shardings exist). Without the constraint, GSPMD propagates a ZeRO-1
+    sharded optimizer slot's layout through ``optax.apply_updates`` into
+    the new params — silently partitioning weights that the pure-DP
+    contract says stay replicated, and forcing a recompile at step 2 when
+    the changed input layout comes back around. Exposes ``lower`` so AOT
+    callers (the bench) keep working."""
+
+    def __init__(self, fn, donate_argnums):
+        self._fn = fn
+        self._donate = donate_argnums
+        self._jitted = None
+
+    def _ensure(self, state):
+        if self._jitted is None:
+            state_sh = jax.tree_util.tree_map(
+                lambda leaf: leaf.sharding
+                if isinstance(leaf, jax.Array) else None, state)
+            self._jitted = jax.jit(
+                self._fn, donate_argnums=self._donate,
+                out_shardings=(state_sh, None))
+        return self._jitted
+
+    def __call__(self, state, batch, rng):
+        return self._ensure(state)(state, batch, rng)
+
+    def lower(self, state, batch, rng):
+        return self._ensure(state).lower(state, batch, rng)
 
 
 class Trainer:
@@ -129,7 +162,7 @@ class Trainer:
             return new_state, metrics
 
         donate = (0,) if self._donate else ()
-        return jax.jit(train_step, donate_argnums=donate)
+        return _LazyShardedJit(train_step, donate)
 
     def _build_eval_step(self):
         loss_fn = self.loss_fn
@@ -170,12 +203,25 @@ class Trainer:
         log_every: int = 50,
         metrics_writer=None,
         start_step: Optional[int] = None,
+        trace_dir: Optional[str] = None,
+        trace_steps: int = 0,
     ) -> TrainState:
         """The step loop. Dispatches async; only syncs on metrics at
         ``log_every`` boundaries so device compute and host input prep overlap
         (the reference achieved this with MXNet/TF's async engines; here it is
-        jax dispatch + explicit sync points)."""
+        jax dispatch + explicit sync points).
+
+        ``trace_dir`` + ``trace_steps``: capture a jax.profiler trace of
+        ``trace_steps`` hot-loop steps (skipping the first, compile-heavy
+        step) — the Horovod-timeline role (SURVEY §6 tracing row).
+        """
+        from ..runtime.profiling import trace_steps as profiler_trace
+
         step = int(state.step) if start_step is None else start_step
+        trace_start = step + 1 if trace_dir and trace_steps > 0 else -1
+        trace_stop = trace_start + trace_steps
+        trace_stack = contextlib.ExitStack()  # owns start/stop (profiling.py)
+        tracing = False
         window_start = time.perf_counter()
         window_examples = 0
         last: Optional[tuple] = None
@@ -187,12 +233,19 @@ class Trainer:
         # queue for the rest of the process.
         try:
             while step < num_steps:
+                if step == trace_start:
+                    trace_stack.enter_context(profiler_trace(trace_dir))
+                    tracing = True
                 batch = next(train_iter)
                 dev_batch = self.device_batch(batch)
                 state, metrics = self.train_step(state, dev_batch, rng)
                 last = (step, metrics)
                 window_examples += gb
                 step += 1
+                if tracing and step >= trace_stop:
+                    jax.block_until_ready(metrics)
+                    trace_stack.close()
+                    tracing = False
 
                 if step % max(log_every, 1) == 0 or step >= num_steps:
                     # Sync point: realize the latest step's metrics.
@@ -235,6 +288,7 @@ class Trainer:
                         )
             return state
         finally:
+            trace_stack.close()  # no-op unless exited mid-capture
             close = getattr(train_iter, "close", None)
             if close is not None:
                 close()

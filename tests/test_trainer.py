@@ -216,6 +216,12 @@ def test_zero1_opt_state_sharding_matches_replicated(tmp_workdir, devices):
     pipe = build_pipeline(cfg.data, cfg.train.global_batch, 10, train=True)
     batch = next(iter(pipe.one_epoch(0)))
 
+    def count_partitioned(tree):
+        return sum(
+            1 for leaf in jax.tree_util.tree_leaves(tree)
+            if hasattr(leaf, "addressable_shards") and leaf.ndim > 0
+            and leaf.addressable_shards[0].data.shape != leaf.shape)
+
     results = []
     for zero1 in (True, False):
         state = create_train_state(jax.random.PRNGKey(0), task.init, tx,
@@ -223,18 +229,19 @@ def test_zero1_opt_state_sharding_matches_replicated(tmp_workdir, devices):
         if zero1:
             # At least one mirror slot must really be partitioned: its
             # addressable shard is smaller than the global array.
-            sharded = [
-                leaf for leaf in jax.tree_util.tree_leaves(state.opt_state)
-                if hasattr(leaf, "addressable_shards") and leaf.ndim > 0
-                and leaf.addressable_shards[0].data.shape != leaf.shape
-            ]
-            assert len(sharded) >= 10, \
-                f"only {len(sharded)} opt slots sharded"
+            assert count_partitioned(state.opt_state) >= 10
         trainer = Trainer(cfg, task.loss_fn, tx, mesh=mesh)
         dev_batch = trainer.device_batch(batch)
         for _ in range(3):
             state, metrics = trainer.train_step(state, dev_batch,
                                                 jax.random.PRNGKey(1))
+        # Layout stability across steps: params must STAY replicated (no
+        # GSPMD leak of the slot sharding through apply_updates) and the
+        # slots must STAY sharded.
+        assert count_partitioned(state.params) == 0, \
+            "params became partitioned after training steps"
+        if zero1:
+            assert count_partitioned(state.opt_state) >= 10
         results.append((float(metrics["loss"]),
                         np.asarray(jax.tree_util.tree_leaves(state.params)[0])))
     (loss_a, w_a), (loss_b, w_b) = results
@@ -258,6 +265,19 @@ def test_training_run_deterministic(tmp_workdir, devices):
                              if "loss" in r])
     assert len(trajectories[0]) == 8
     assert trajectories[0] == trajectories[1], trajectories
+
+
+def test_profile_steps_captures_trace(tmp_workdir, devices):
+    """train.profile_steps captures a TensorBoard-format profiler trace of
+    hot-loop steps into <workdir>/<preset>/profile (SURVEY §6 tracing row
+    — the Horovod-timeline role, reachable from config)."""
+    cfg = _tiny_cfg(tmp_workdir, steps=4)
+    apply_overrides(cfg, ["train.profile_steps=2"])
+    run_experiment(cfg)
+    trace_root = os.path.join(tmp_workdir, "cifar10_resnet20", "profile")
+    files = [os.path.join(dp, f) for dp, _, fs in os.walk(trace_root)
+             for f in fs]
+    assert files, f"no trace files under {trace_root}"
 
 
 def test_remat_flag_trains(tmp_workdir, devices):
