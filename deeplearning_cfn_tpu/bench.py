@@ -35,6 +35,8 @@ _UNITS = {
     "maskrcnn_coco": "images/sec/chip",
     "bert_base_wikipedia": "sequences/sec/chip",
     "transformer_nmt_wmt": "sequences/sec/chip",
+    "bert_moe_wikipedia": "sequences/sec/chip",
+    "bert_pipelined_wikipedia": "sequences/sec/chip",
 }
 
 # Peak dense bf16 FLOPs/sec per chip, keyed by device_kind substring.
@@ -142,7 +144,7 @@ def run_bench(
     n_chips = mesh.devices.size
     gb = cfg.train.global_batch
 
-    task = build_task(cfg)
+    task = build_task(cfg, mesh=mesh)
     sched = build_schedule(cfg.schedule, max(steps * 10, 1000), gb, 100)
     tx = build_optimizer(cfg.optimizer, sched)
     state = create_train_state(jax.random.PRNGKey(0), task.init, tx, mesh,

@@ -27,6 +27,12 @@ class MeshConfig:
                  DP-allreduce / KVStore dist_sync both map here).
       model    — tensor-parallel axis; reserved so pjit specs extend later.
       spatial  — image H/W sharding for Mask R-CNN's "data+spatial shard".
+      expert   — expert-parallel axis (MoE): stacked expert weights shard
+                 over it; batch shards ride it too outside MoE layers, so
+                 non-expert compute stays fully data-parallel.
+      pipe     — pipeline-parallel axis: stacked trunk layers shard their
+                 leading layer dim over it and run the SPMD GPipe schedule
+                 (ops/pipeline.py); batch stays replicated across 'pipe'.
       num_slices — multi-slice (DCN) scale-out: >1 builds a hybrid mesh
                  with an outer 'dcn_data' axis spanning slice boundaries.
                  Batch dim shards over (dcn_data, data) jointly; params stay
@@ -39,6 +45,8 @@ class MeshConfig:
     data: int = -1
     model: int = 1
     spatial: int = 1
+    expert: int = 1
+    pipe: int = 1
     num_slices: int = 1
 
 

@@ -19,6 +19,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from . import register_model
+from .moe import MOE_PARAM_RULES
 from .transformer import (
     Embed,
     TRANSFORMER_PARAM_RULES,
@@ -26,10 +27,15 @@ from .transformer import (
     padding_bias,
 )
 
-PARAM_RULES = TRANSFORMER_PARAM_RULES
+# MoE rules are harmless when no MoE layers exist (regexes match nothing).
+PARAM_RULES = TRANSFORMER_PARAM_RULES + MOE_PARAM_RULES
 
 
 class BertEncoder(nn.Module):
+    """``num_experts > 0`` turns every ``moe_every``-th layer into a
+    Mixture-of-Experts layer (GShard's every-other-layer convention at the
+    default 2); the summed aux losses come back as the third return."""
+
     vocab_size: int
     hidden_size: int = 768
     num_layers: int = 12
@@ -39,6 +45,10 @@ class BertEncoder(nn.Module):
     dtype: Any = jnp.bfloat16
     dropout_rate: float = 0.0
     attention_impl: str = "auto"
+    num_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_top_k: int = 2
 
     @nn.compact
     def __call__(self, input_ids, input_mask, segment_ids,
@@ -49,13 +59,30 @@ class BertEncoder(nn.Module):
             dropout_rate=self.dropout_rate, name="embed",
         )(input_ids, segment_ids, deterministic=deterministic)
         bias = padding_bias(input_mask)
+        moe_aux = {"load_balance": jnp.zeros((), jnp.float32),
+                   "router_z": jnp.zeros((), jnp.float32)}
+        n_moe = 0
         for i in range(self.num_layers):
-            x = TransformerLayer(
+            is_moe = (self.num_experts > 0
+                      and i % self.moe_every == self.moe_every - 1)
+            layer = TransformerLayer(
                 self.num_heads, self.mlp_dim, self.dtype,
                 self.dropout_rate, prenorm=False,
-                attention_impl=self.attention_impl, name=f"layer_{i}",
-            )(x, self_bias=bias, deterministic=deterministic)
-        return x, token_emb
+                attention_impl=self.attention_impl,
+                num_experts=self.num_experts if is_moe else 0,
+                moe_capacity_factor=self.moe_capacity_factor,
+                moe_top_k=self.moe_top_k, name=f"layer_{i}",
+            )
+            if is_moe:
+                x, aux = layer(x, self_bias=bias,
+                               deterministic=deterministic)
+                moe_aux = {k: moe_aux[k] + aux[k] for k in moe_aux}
+                n_moe += 1
+            else:
+                x = layer(x, self_bias=bias, deterministic=deterministic)
+        if n_moe:
+            moe_aux = {k: v / n_moe for k, v in moe_aux.items()}
+        return x, token_emb, moe_aux
 
 
 class BertPretrain(nn.Module):
@@ -71,14 +98,21 @@ class BertPretrain(nn.Module):
     dtype: Any = jnp.bfloat16
     dropout_rate: float = 0.0
     attention_impl: str = "auto"
+    num_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_top_k: int = 2
 
     @nn.compact
     def __call__(self, input_ids, input_mask, segment_ids, mlm_positions,
                  train: bool = True):
-        x, token_emb = BertEncoder(
+        x, token_emb, moe_aux = BertEncoder(
             self.vocab_size, self.hidden_size, self.num_layers,
             self.num_heads, self.mlp_dim, self.max_len, self.dtype,
-            self.dropout_rate, self.attention_impl, name="encoder",
+            self.dropout_rate, self.attention_impl,
+            num_experts=self.num_experts, moe_every=self.moe_every,
+            moe_capacity_factor=self.moe_capacity_factor,
+            moe_top_k=self.moe_top_k, name="encoder",
         )(input_ids, input_mask, segment_ids, deterministic=not train)
 
         # MLM head on the masked positions only ([B,P] gather — static P).
@@ -101,7 +135,11 @@ class BertPretrain(nn.Module):
             name="pooler")(x[:, 0, :].astype(jnp.float32)))
         nsp_logits = nn.Dense(self.num_classes, dtype=jnp.float32,
                               name="nsp_head")(pooled)
-        return {"mlm_logits": mlm_logits, "nsp_logits": nsp_logits}
+        out = {"mlm_logits": mlm_logits, "nsp_logits": nsp_logits}
+        if self.num_experts > 0:
+            out["moe_load_balance"] = moe_aux["load_balance"]
+            out["moe_router_z"] = moe_aux["router_z"]
+        return out
 
 
 @register_model("bert_base")
@@ -109,13 +147,17 @@ def bert_base(num_classes: int = 2, dtype=jnp.bfloat16, *,
               vocab_size: int = 30522, hidden_size: int = 768,
               num_layers: int = 12, num_heads: int = 12,
               mlp_dim: int = 3072, max_len: int = 512,
-              dropout_rate: float = 0.0, attention_impl: str = "auto"):
+              dropout_rate: float = 0.0, attention_impl: str = "auto",
+              num_experts: int = 0, moe_every: int = 2,
+              moe_capacity_factor: float = 1.25, moe_top_k: int = 2):
     return BertPretrain(
         vocab_size=vocab_size, num_classes=num_classes,
         hidden_size=hidden_size, num_layers=num_layers,
         num_heads=num_heads, mlp_dim=mlp_dim, max_len=max_len,
         dtype=dtype, dropout_rate=dropout_rate,
-        attention_impl=attention_impl)
+        attention_impl=attention_impl, num_experts=num_experts,
+        moe_every=moe_every, moe_capacity_factor=moe_capacity_factor,
+        moe_top_k=moe_top_k)
 
 
 @register_model("bert_tiny")

@@ -1,0 +1,162 @@
+"""Mixture-of-Experts FFN with expert parallelism over the 'expert' axis.
+
+The reference has no MoE (its workloads predate it — SURVEY.md §3.2 lists
+EP as absent); this module extends the rebuild's parallelism inventory the
+TPU-native way: the GShard/Switch formulation, where routing is expressed
+as dense one-hot einsums over STATIC shapes — argmax + cumsum position
+assignment, a fixed per-expert capacity, dropped-token masking — so the
+whole layer compiles to MXU-friendly batched matmuls with no dynamic
+shapes, and GSPMD partitions the expert dim of the stacked expert weights
+over the mesh 'expert' axis (the all-to-all dispatch/combine collectives
+are compiler-inserted, the same way the data-parallel psum is).
+
+Design notes:
+- Router runs in float32 (standard practice: bf16 router logits make
+  top-k selection noisy near ties).
+- Top-k routing (default 2, the GShard choice) with first-choice priority:
+  choice-k tokens only claim capacity left over by choices < k.
+- Load-balance aux loss (Switch form: E * sum_e f_e * p_e, where f_e is
+  the fraction of tokens whose FIRST choice is e and p_e the mean router
+  probability) plus a router z-loss (ST-MoE) for logit stability. Both are
+  returned to the caller, which owns the weighting into the total loss —
+  they are per-token means, so they stay correct under a sharded batch.
+- Expert weights are stacked [E, ...] and sharded over 'expert' by
+  MOE_PARAM_RULES; the token tensors stay batch-sharded (the 'expert' mesh
+  axis also carries batch shards outside this layer — see
+  parallel/mesh.py BATCH_AXES), so GSPMD inserts the dispatch/combine
+  resharding only around the expert einsums.
+- No dropout inside the expert MLP: the capacity-drop mechanism already
+  regularizes token→expert assignment, and keeping the expert compute a
+  pure pair of einsums lets XLA fuse the activation into the matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+Dtype = Any
+
+# Param-path rules for the 'expert' mesh axis (see
+# parallel.sharding.param_sharding_tree): stacked expert weights shard
+# their leading expert dim; the router stays replicated.
+MOE_PARAM_RULES = (
+    (r"moe_mlp/w_in", P("expert", None, None)),
+    (r"moe_mlp/w_out", P("expert", None, None)),
+    (r"moe_mlp/b_in", P("expert", None)),
+    (r"moe_mlp/b_out", P("expert", None)),
+)
+
+
+def router_assignment(
+    probs: jnp.ndarray, capacity: int, top_k: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Static-shape token→expert assignment.
+
+    probs: [B, S, E] router probabilities. Returns (dispatch, combine):
+    dispatch [B, S, E, C] is a 0/1 mask placing each kept token in one
+    capacity slot of each chosen expert; combine is dispatch scaled by the
+    token's (renormalized) gate for that expert.
+
+    Position assignment is first-come within the sequence (cumsum order),
+    with choice-rank priority: all first-choice tokens claim slots before
+    any second-choice token, matching GShard's scheme.
+    """
+    b, s, e = probs.shape
+    remaining = probs
+    kept_per_expert = jnp.zeros((b, e), probs.dtype)  # slots already claimed
+    dispatch = jnp.zeros((b, s, e, capacity), probs.dtype)
+    gates = []
+    masks = []
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)                      # [B, S]
+        mask = jax.nn.one_hot(idx, e, dtype=probs.dtype)          # [B, S, E]
+        # Slot index for each token: tokens earlier in the sequence first,
+        # offset by slots already claimed by higher-priority choices.
+        pos = (jnp.cumsum(mask, axis=1) - mask) \
+            + kept_per_expert[:, None, :]                          # [B, S, E]
+        keep = mask * (pos < capacity)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                              dtype=probs.dtype)                   # [B,S,E,C]
+        dispatch = dispatch + keep[..., None] * slot
+        kept_per_expert = kept_per_expert + jnp.sum(keep, axis=1)
+        gates.append(jnp.sum(probs * mask, axis=-1))               # [B, S]
+        masks.append(keep)
+        remaining = remaining * (1.0 - mask)
+    # Renormalize the k gates to sum to 1 over the token's chosen experts,
+    # then zero the dropped ones.
+    gate_stack = jnp.stack(gates, axis=-1)                         # [B, S, K]
+    gate_stack = gate_stack / jnp.maximum(
+        jnp.sum(gate_stack, axis=-1, keepdims=True), 1e-9)
+    combine = jnp.zeros_like(dispatch)
+    for k, keep in enumerate(masks):
+        # keep is one-hot over E for choice k; place its gate in the slot.
+        slot = dispatch * keep[..., None]                          # [B,S,E,C]
+        combine = combine + slot * gate_stack[..., k][..., None, None]
+    return dispatch, combine
+
+
+class MoeMlp(nn.Module):
+    """Drop-in MoE replacement for transformer.Mlp.
+
+    Returns ``(y, aux)`` where aux = {"load_balance": ..., "router_z": ...}
+    (unweighted scalars; the model sums them into its loss with its own
+    weights).
+    """
+
+    num_experts: int
+    mlp_dim: int
+    capacity_factor: float = 1.25
+    top_k: int = 2
+    dtype: Dtype = jnp.bfloat16
+    act: Callable = nn.gelu
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True
+                 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        b, s, f = x.shape
+        e, m = self.num_experts, self.mlp_dim
+        if self.top_k > e:
+            raise ValueError(f"top_k={self.top_k} > num_experts={e}")
+        capacity = max(1, int(self.top_k * s / e * self.capacity_factor))
+
+        logits = nn.Dense(e, dtype=jnp.float32, param_dtype=jnp.float32,
+                          kernel_init=nn.initializers.normal(0.02),
+                          use_bias=False, name="router")(x.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)                    # [B, S, E]
+        dispatch, combine = router_assignment(probs, capacity, self.top_k)
+        dispatch = dispatch.astype(self.dtype)
+        combine = combine.astype(self.dtype)
+
+        # Stacked expert weights, expert dim sharded over the mesh.
+        w_in = self.param("w_in", nn.initializers.xavier_uniform(),
+                          (e, f, m), jnp.float32)
+        b_in = self.param("b_in", nn.initializers.zeros_init(),
+                          (e, m), jnp.float32)
+        w_out = self.param("w_out", nn.initializers.xavier_uniform(),
+                           (e, m, f), jnp.float32)
+        b_out = self.param("b_out", nn.initializers.zeros_init(),
+                           (e, f), jnp.float32)
+
+        xd = x.astype(self.dtype)
+        # Dispatch: gather each expert's capacity slots from the sequence.
+        x_e = jnp.einsum("bsec,bsf->becf", dispatch, xd)           # [B,E,C,F]
+        h = jnp.einsum("becf,efm->becm", x_e, w_in.astype(self.dtype))
+        h = self.act(h + b_in.astype(self.dtype)[None, :, None, :])
+        y_e = jnp.einsum("becm,emf->becf", h, w_out.astype(self.dtype))
+        y_e = y_e + b_out.astype(self.dtype)[None, :, None, :]
+        # Combine: scatter expert outputs back to token positions, gated.
+        y = jnp.einsum("bsec,becf->bsf", combine, y_e)
+
+        # Aux losses (float32, per-token means — DP/psum-correct).
+        first_choice = jax.nn.one_hot(jnp.argmax(probs, -1), e,
+                                      dtype=jnp.float32)
+        f_e = jnp.mean(first_choice, axis=(0, 1))                  # [E]
+        p_e = jnp.mean(probs, axis=(0, 1))                         # [E]
+        load_balance = e * jnp.sum(f_e * p_e)
+        router_z = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+        return y, {"load_balance": load_balance, "router_z": router_z}

@@ -1,0 +1,212 @@
+"""Pipeline-parallel BERT: the encoder trunk as stacked-layer GPipe stages.
+
+Same task contract as models/bert.py BertPretrain (MLM+NSP over the
+data/text.py batch layout — drop-in for MlmTask via model name
+"bert_pipelined"), but the encoder's L layers live as STACKED parameters
+[L, ...] sharded over the mesh 'pipe' axis and run under the SPMD GPipe
+schedule in ops/pipeline.py. Embedding and the MLM/NSP heads stay
+replicated over 'pipe' (they are a small fraction of the FLOPs; sharding
+them would buy little and cost an extra transfer each way).
+
+The reference has no pipeline parallelism (SURVEY.md §3.2); this is the
+rebuild's PP entry, built TPU-first: one traced block body per stage
+(lax.scan over the stage's local layers), activation hops as ppermute on
+ICI, bf16 activations, f32 params/LayerNorm statistics, attention through
+ops.fused_attention.
+
+Dropout is not supported in the pipelined trunk (rate must be 0): per-tick
+RNG plumbing through the schedule would buy nothing for the pretraining
+recipes this backs (they regularize via MLM masking), and keeping the
+stage body pure keeps the scan/ppermute AD transpose exact.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import register_model
+from ..ops import fused_attention
+from ..ops.pipeline import gpipe, scan_layers
+
+Dtype = Any
+
+# The one rule the 'pipe' axis needs: every stacked trunk param shards its
+# leading layer dim (see parallel.sharding.param_sharding_tree — a spec
+# shorter than the leaf rank leaves the remaining dims replicated).
+PARAM_RULES = ((r"pipe_stack/", P("pipe")),)
+
+_EPS = 1e-6
+
+
+def _layer_norm(x, scale, bias):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + _EPS)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _block(num_heads: int, attention_impl: str, params, state):
+    """One post-LN BERT block over a microbatch; pure function of stacked
+    per-layer params (models/bert.py's TransformerLayer, functionalized so
+    it can scan over the stage's layer stack)."""
+    h, bias = state["h"], state["bias"]
+    dt = h.dtype
+    b, s, f = h.shape
+    d = f // num_heads
+
+    def dense(t, w, bb):
+        return (t @ w.astype(dt)) + bb.astype(dt)
+
+    def split(t):  # [mb,S,F] -> [mb,H,S,D]
+        return t.reshape(b, s, num_heads, d).transpose(0, 2, 1, 3)
+
+    q = split(dense(h, params["wq"], params["bq"]))
+    k = split(dense(h, params["wk"], params["bk"]))
+    v = split(dense(h, params["wv"], params["bv"]))
+    attn = fused_attention(q, k, v, bias=bias,
+                           implementation=attention_impl)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, f)
+    attn = dense(attn, params["wo"], params["bo"])
+    h = _layer_norm(h + attn, params["ln1_s"], params["ln1_b"])
+    y = nn.gelu(dense(h, params["w_in"], params["b_in"]))
+    y = dense(y, params["w_out"], params["b_out"])
+    h = _layer_norm(h + y, params["ln2_s"], params["ln2_b"])
+    return {"h": h, "bias": bias}
+
+
+class PipeStack(nn.Module):
+    """Owns the stacked trunk params and runs them — pipelined over the
+    mesh 'pipe' axis when one is live, plain scan otherwise (init, tests,
+    non-pipe meshes: numerics are identical by construction)."""
+
+    num_layers: int
+    num_heads: int
+    mlp_dim: int
+    dtype: Dtype = jnp.bfloat16
+    attention_impl: str = "auto"
+    mesh: Any = None
+    n_microbatches: int = 4
+    batch_spec: Any = "data"
+
+    @nn.compact
+    def __call__(self, h, bias):
+        l, f, m = self.num_layers, h.shape[-1], self.mlp_dim
+        kernel = nn.initializers.variance_scaling(
+            1.0, "fan_avg", "uniform", in_axis=-2, out_axis=-1,
+            batch_axis=(0,))
+        zeros, ones = nn.initializers.zeros_init(), nn.initializers.ones_init()
+
+        def p(name, init, *shape):
+            return self.param(name, init, (l,) + shape, jnp.float32)
+
+        params = {
+            "wq": p("wq", kernel, f, f), "bq": p("bq", zeros, f),
+            "wk": p("wk", kernel, f, f), "bk": p("bk", zeros, f),
+            "wv": p("wv", kernel, f, f), "bv": p("bv", zeros, f),
+            "wo": p("wo", kernel, f, f), "bo": p("bo", zeros, f),
+            "ln1_s": p("ln1_s", ones, f), "ln1_b": p("ln1_b", zeros, f),
+            "w_in": p("w_in", kernel, f, m), "b_in": p("b_in", zeros, m),
+            "w_out": p("w_out", kernel, m, f), "b_out": p("b_out", zeros, f),
+            "ln2_s": p("ln2_s", ones, f), "ln2_b": p("ln2_b", zeros, f),
+        }
+        layer_fn = lambda lp, st: _block(
+            self.num_heads, self.attention_impl, lp, st)
+        stage_fn = scan_layers(layer_fn)
+        state = {"h": h.astype(self.dtype), "bias": bias}
+        pipe_size = (self.mesh.shape.get("pipe", 1)
+                     if self.mesh is not None else 1)
+        # init traces with a batch-1 dummy that can't shard over 'data' or
+        # split into microbatches; the plain scan path creates identical
+        # params (same names/shapes) and identical numerics.
+        if self.is_initializing():
+            pipe_size = 1
+        if pipe_size > 1:
+            if l % pipe_size:
+                raise ValueError(
+                    f"num_layers={l} not divisible by pipe axis "
+                    f"{pipe_size}")
+            out = gpipe(stage_fn, params, state, mesh=self.mesh,
+                        n_microbatches=self.n_microbatches,
+                        batch_spec=self.batch_spec)
+        else:
+            out = stage_fn(params, state)
+        return out["h"]
+
+
+class PipelinedBert(nn.Module):
+    """BertPretrain's contract (models/bert.py) with a pipelined trunk."""
+
+    vocab_size: int
+    num_classes: int = 2
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    dtype: Dtype = jnp.bfloat16
+    dropout_rate: float = 0.0
+    attention_impl: str = "auto"
+    mesh: Any = None
+    n_microbatches: int = 4
+    batch_spec: Any = "data"
+
+    @nn.compact
+    def __call__(self, input_ids, input_mask, segment_ids, mlm_positions,
+                 train: bool = True):
+        if self.dropout_rate:
+            raise ValueError("pipelined trunk does not support dropout; "
+                             "set dropout_rate=0")
+        from .transformer import Embed, padding_bias
+
+        x, token_emb = Embed(
+            self.vocab_size, self.hidden_size, self.max_len,
+            num_segments=2, dtype=self.dtype, name="embed",
+        )(input_ids, segment_ids, deterministic=True)
+        bias = padding_bias(input_mask)
+        x = PipeStack(
+            self.num_layers, self.num_heads, self.mlp_dim, self.dtype,
+            self.attention_impl, self.mesh, self.n_microbatches,
+            self.batch_spec, name="pipe_stack",
+        )(x, bias)
+
+        # Heads: same math as models/bert.py BertPretrain.
+        gathered = jnp.take_along_axis(
+            x, mlm_positions[:, :, None].astype(jnp.int32), axis=1)
+        h = nn.Dense(self.hidden_size, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="mlm_transform")(gathered)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="mlm_norm")(h)
+        mlm_logits = token_emb.attend(h.astype(jnp.float32))
+        mlm_bias = self.param("mlm_bias", nn.initializers.zeros_init(),
+                              (self.vocab_size,), jnp.float32)
+        mlm_logits = mlm_logits + mlm_bias
+        pooled = nn.tanh(nn.Dense(
+            self.hidden_size, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="pooler")(x[:, 0, :].astype(jnp.float32)))
+        nsp_logits = nn.Dense(self.num_classes, dtype=jnp.float32,
+                              name="nsp_head")(pooled)
+        return {"mlm_logits": mlm_logits, "nsp_logits": nsp_logits}
+
+
+@register_model("bert_pipelined")
+def bert_pipelined(num_classes: int = 2, dtype=jnp.bfloat16, *,
+                   vocab_size: int = 30522, hidden_size: int = 768,
+                   num_layers: int = 12, num_heads: int = 12,
+                   mlp_dim: int = 3072, max_len: int = 512,
+                   dropout_rate: float = 0.0, attention_impl: str = "auto",
+                   mesh=None, n_microbatches: int = 4,
+                   batch_spec="data"):
+    return PipelinedBert(
+        vocab_size=vocab_size, num_classes=num_classes,
+        hidden_size=hidden_size, num_layers=num_layers,
+        num_heads=num_heads, mlp_dim=mlp_dim, max_len=max_len,
+        dtype=dtype, dropout_rate=dropout_rate,
+        attention_impl=attention_impl, mesh=mesh,
+        n_microbatches=n_microbatches, batch_spec=batch_spec)

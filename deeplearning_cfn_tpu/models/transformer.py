@@ -146,6 +146,11 @@ class TransformerLayer(nn.Module):
 
     ``prenorm=False`` is the BERT/original-transformer post-LN layout;
     ``prenorm=True`` the more stable pre-LN used by the NMT preset.
+
+    ``num_experts > 0`` swaps the dense FFN for a Mixture-of-Experts FFN
+    (models/moe.py) and changes the return type to ``(x, moe_aux)`` where
+    moe_aux is the MoE layer's aux-loss dict — callers that enable MoE own
+    threading those losses into the objective.
     """
 
     num_heads: int
@@ -155,6 +160,9 @@ class TransformerLayer(nn.Module):
     prenorm: bool = False
     cross_attention: bool = False
     attention_impl: str = "auto"
+    num_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_top_k: int = 2
 
     @nn.compact
     def __call__(self, x, enc=None, self_bias=None, cross_bias=None,
@@ -189,6 +197,21 @@ class TransformerLayer(nn.Module):
                     y, kv=enc, bias=cross_bias,
                     deterministic=deterministic),
                 "cross_attn")
+        if self.num_experts > 0:
+            from .moe import MoeMlp
+
+            moe = MoeMlp(self.num_experts, self.mlp_dim,
+                         self.moe_capacity_factor, self.moe_top_k,
+                         self.dtype, name="moe_mlp")
+            aux_box = {}
+
+            def moe_sub(y):
+                out, aux = moe(y, deterministic=deterministic)
+                aux_box.update(aux)
+                return out
+
+            x = residual(x, moe_sub, "mlp")
+            return x, aux_box
         x = residual(
             x, lambda y: Mlp(self.mlp_dim, self.dtype, self.dropout_rate,
                              name="mlp")(y, deterministic=deterministic),

@@ -25,12 +25,18 @@ from ..config import MeshConfig
 # Axis order matters: 'dcn_data' outermost (slice boundaries are the
 # slowest links — only the one gradient allreduce hop should cross them),
 # then 'data' so per-host batches stay contiguous (each host feeds only its
-# local shard of the batch), 'model' innermost so tensor-parallel
-# collectives ride the shortest ICI hops.
-AXIS_ORDER: Tuple[str, ...] = ("dcn_data", "data", "spatial", "model")
-# Batch dim 0 shards over both data axes jointly; with one slice the
-# dcn_data axis has size 1 and the spec degenerates to plain DP.
-BATCH_AXES: Tuple[str, ...] = ("dcn_data", "data")
+# local shard of the batch), then 'expert' (MoE all-to-alls are bigger than
+# grad psums per hop, but batch shards ride it too), 'model' innermost so
+# tensor-parallel collectives ride the shortest ICI hops.
+AXIS_ORDER: Tuple[str, ...] = ("dcn_data", "pipe", "data", "expert",
+                               "spatial", "model")
+# Batch dim 0 shards over all of these jointly: the 'expert' axis carries
+# batch shards outside MoE layers (GSPMD MoE — tokens are data-parallel
+# everywhere except the expert einsums, where the stacked expert weights
+# are sharded over 'expert' and the compiler inserts the dispatch
+# all-to-all). With one slice / no MoE the extra axes are size 1 and the
+# spec degenerates to plain DP.
+BATCH_AXES: Tuple[str, ...] = ("dcn_data", "data", "expert")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,13 +47,17 @@ class MeshSpec:
     model: int = 1
     spatial: int = 1
     dcn_data: int = 1
+    expert: int = 1
+    pipe: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.model * self.spatial * self.dcn_data
+        return (self.data * self.model * self.spatial * self.dcn_data
+                * self.expert * self.pipe)
 
     def axis_sizes(self) -> Dict[str, int]:
-        return {"dcn_data": self.dcn_data, "data": self.data,
+        return {"dcn_data": self.dcn_data, "pipe": self.pipe,
+                "data": self.data, "expert": self.expert,
                 "spatial": self.spatial, "model": self.model}
 
     @classmethod
@@ -57,29 +67,32 @@ class MeshSpec:
         by hand via ``$DEEPLEARNING_WORKERS_COUNT × GPUs``."""
         model = cfg.model
         spatial = cfg.spatial
+        expert = getattr(cfg, "expert", 1)
+        pipe = getattr(cfg, "pipe", 1)
         slices = getattr(cfg, "num_slices", 1)
-        if model < 1 or spatial < 1 or slices < 1:
+        if min(model, spatial, slices, expert, pipe) < 1:
             raise ValueError(f"mesh axes must be >=1, got {cfg}")
         if num_devices % slices != 0:
             raise ValueError(
                 f"num_slices={slices} does not divide device count "
                 f"{num_devices}")
         per_slice = num_devices // slices
-        fixed = model * spatial
+        fixed = model * spatial * expert * pipe
         if per_slice % fixed != 0:
             raise ValueError(
-                f"model*spatial={fixed} does not divide per-slice device "
-                f"count {per_slice}"
+                f"pipe*model*spatial*expert={fixed} does not divide "
+                f"per-slice device count {per_slice}"
             )
         data = cfg.data
         if data == -1:
             data = per_slice // fixed
         if data * fixed != per_slice:
             raise ValueError(
-                f"mesh {data}x{spatial}x{model} != {per_slice} devices/slice; "
-                f"set data=-1 to auto-size"
+                f"mesh {pipe}x{data}x{expert}x{spatial}x{model} != "
+                f"{per_slice} devices/slice; set data=-1 to auto-size"
             )
-        return cls(data=data, model=model, spatial=spatial, dcn_data=slices)
+        return cls(data=data, model=model, spatial=spatial,
+                   dcn_data=slices, expert=expert, pipe=pipe)
 
 
 def build_mesh(
@@ -131,8 +144,10 @@ def build_mesh(
 
 def data_axis_size(mesh: Mesh) -> int:
     """Total batch-sharding ways: the 'data' axis times the cross-slice
-    'dcn_data' axis (1 on single-slice meshes)."""
-    return mesh.shape["data"] * mesh.shape.get("dcn_data", 1)
+    'dcn_data' axis times the 'expert' axis (batch shards ride 'expert'
+    outside MoE layers — see BATCH_AXES)."""
+    return (mesh.shape["data"] * mesh.shape.get("dcn_data", 1)
+            * mesh.shape.get("expert", 1))
 
 
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:

@@ -37,14 +37,16 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def batch_sharding(mesh: Mesh, ndim: int, spatial_dim: Optional[int] = None) -> NamedSharding:
-    """Batch tensors: dim 0 over the data axes — ('dcn_data', 'data')
-    jointly on multi-slice meshes, plain 'data' otherwise — optionally one
-    spatial dim over 'spatial' (Mask R-CNN's data+spatial shard)."""
+    """Batch tensors: dim 0 jointly over whichever of the BATCH_AXES
+    ('dcn_data', 'data', 'expert') are >1 on this mesh — plain 'data' on a
+    pure-DP mesh — optionally one spatial dim over 'spatial' (Mask R-CNN's
+    data+spatial shard)."""
     spec: list = [None] * ndim
-    if mesh.shape.get("dcn_data", 1) > 1:
-        spec[0] = BATCH_AXES
+    axes = tuple(a for a in BATCH_AXES if mesh.shape.get(a, 1) > 1)
+    if len(axes) > 1:
+        spec[0] = axes
     else:
-        spec[0] = "data"
+        spec[0] = axes[0] if axes else "data"
     if spatial_dim is not None and mesh.shape.get("spatial", 1) > 1:
         spec[spatial_dim] = "spatial"
     return NamedSharding(mesh, P(*spec))

@@ -123,6 +123,61 @@ def _bert_base() -> ExperimentConfig:
     )
 
 
+@register_preset("bert_moe_wikipedia")
+def _bert_moe() -> ExperimentConfig:
+    """BERT-base with Mixture-of-Experts FFNs (every other layer, 8
+    experts, top-2) on a data×expert mesh — the expert-parallelism
+    flagship. No reference equivalent (SURVEY.md §3.2 lists EP as absent);
+    recipe is bert_base_wikipedia's with the GShard layer convention and
+    ST-MoE aux-loss weights (train/task.py)."""
+    return ExperimentConfig(
+        model=ModelConfig(
+            name="bert_base",
+            num_classes=2,
+            kwargs=dict(
+                hidden_size=768, num_layers=12, num_heads=12, mlp_dim=3072,
+                max_len=512, dropout_rate=0.1,
+                num_experts=8, moe_every=2, moe_top_k=2,
+            ),
+        ),
+        data=DataConfig(name="wikipedia_mlm", seq_len=128, vocab_size=30522),
+        train=TrainConfig(global_batch=1024, steps=100_000, dtype="bfloat16",
+                          shard_opt_state=True),
+        optimizer=OptimizerConfig(name="lamb", weight_decay=0.01,
+                                  grad_clip_norm=1.0),
+        schedule=ScheduleConfig(name="cosine", base_lr=1e-3, warmup_steps=3000),
+        mesh=MeshConfig(data=-1, expert=8),
+        stack=StackConfig(slice_type="v5p-64"),
+    )
+
+
+@register_preset("bert_pipelined_wikipedia")
+def _bert_pipelined() -> ExperimentConfig:
+    """BERT-base with the trunk pipelined over 4 stages (GPipe schedule,
+    ops/pipeline.py) — the pipeline-parallelism flagship. No reference
+    equivalent (SURVEY.md §3.2 lists PP as absent). Dropout must be 0 in
+    the pipelined trunk (models/pipelined.py); 8 microbatches keep the
+    bubble at (4-1)/(8+4-1) ≈ 27% of ticks."""
+    return ExperimentConfig(
+        model=ModelConfig(
+            name="bert_pipelined",
+            num_classes=2,
+            kwargs=dict(
+                hidden_size=768, num_layers=12, num_heads=12, mlp_dim=3072,
+                max_len=512, n_microbatches=8,
+            ),
+        ),
+        data=DataConfig(name="wikipedia_mlm", seq_len=128, vocab_size=30522),
+        train=TrainConfig(global_batch=1024, steps=100_000, dtype="bfloat16",
+                          shard_opt_state=True),
+        optimizer=OptimizerConfig(name="lamb", weight_decay=0.01,
+                                  grad_clip_norm=1.0),
+        schedule=ScheduleConfig(name="cosine", base_lr=1e-3, warmup_steps=3000),
+        mesh=MeshConfig(data=-1, pipe=4),
+        stack=StackConfig(slice_type="v5p-64"),
+    )
+
+
 @register_preset("maskrcnn_coco")
 def _maskrcnn() -> ExperimentConfig:
     """Mask R-CNN COCO — the one beyond-DP config: pjit data+spatial shard

@@ -33,7 +33,7 @@ def run_experiment(
 ) -> Dict[str, float]:
     """Run (or resume) the experiment; returns final eval metrics."""
     mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
-    task = build_task(cfg)
+    task = build_task(cfg, mesh=mesh)
 
     local_batch = local_batch_size(cfg.train.global_batch, mesh)
     train_pipe = build_pipeline(cfg.data, local_batch,

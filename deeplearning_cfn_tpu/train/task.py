@@ -20,6 +20,11 @@ from ..models import build_model
 
 PyTree = Any
 
+# MoE auxiliary-loss weights (ST-MoE's standard values); applied by tasks
+# whose model reports router losses (models/moe.py).
+MOE_LOAD_BALANCE_WEIGHT = 0.01
+MOE_ROUTER_Z_WEIGHT = 0.001
+
 
 def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
                   smoothing: float = 0.0) -> jnp.ndarray:
@@ -151,17 +156,27 @@ class MlmTask:
 
     exact_eval = True
 
-    def __init__(self, cfg: ExperimentConfig):
+    def __init__(self, cfg: ExperimentConfig, mesh=None):
         self.cfg = cfg
         dtype = jnp.bfloat16 if cfg.train.dtype == "bfloat16" else jnp.float32
         kwargs = dict(cfg.model.kwargs)
         kwargs.setdefault("vocab_size", cfg.data.vocab_size)
         kwargs.setdefault("max_len", max(cfg.data.seq_len, 128))
+        if cfg.model.name == "bert_pipelined":
+            # The pipelined trunk runs shard_map over the mesh; give it the
+            # trainer's mesh and the batch-dim spec the trainer will feed.
+            from ..models.pipelined import PARAM_RULES
+            from ..parallel.mesh import build_mesh
+            from ..parallel.sharding import batch_sharding
+
+            mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
+            kwargs.setdefault("mesh", mesh)
+            kwargs.setdefault("batch_spec", batch_sharding(mesh, 1).spec[0])
+        else:
+            from ..models.bert import PARAM_RULES
+        self.param_rules = PARAM_RULES
         self.model = build_model(cfg.model.name, cfg.model.num_classes,
                                  dtype, **kwargs)
-        from ..models.bert import PARAM_RULES
-
-        self.param_rules = PARAM_RULES
         self.remat = cfg.train.remat
 
     def init(self, rng: jax.Array):
@@ -190,6 +205,11 @@ class MlmTask:
         nsp_ce = cross_entropy(out["nsp_logits"], batch["nsp_label"])
         nsp_loss = jnp.sum(nsp_ce * mask) / example_denom
         loss = mlm_loss + nsp_loss
+        if "moe_load_balance" in out:
+            # MoE models: load-balance + router z-loss at the standard
+            # ST-MoE weights. Per-token means, so DP psum stays correct.
+            loss = loss + MOE_LOAD_BALANCE_WEIGHT * out["moe_load_balance"] \
+                + MOE_ROUTER_Z_WEIGHT * out["moe_router_z"]
         mlm_hits = (jnp.argmax(out["mlm_logits"], -1) == batch["mlm_ids"])
         nsp_hits = (jnp.argmax(out["nsp_logits"], -1) == batch["nsp_label"]) \
             .astype(jnp.float32)
@@ -199,6 +219,9 @@ class MlmTask:
             "mlm_accuracy": jnp.sum(mlm_hits * weights) / token_denom,
             "nsp_accuracy": jnp.sum(nsp_hits * mask) / example_denom,
         }
+        if "moe_load_balance" in out:
+            aux["moe_load_balance"] = out["moe_load_balance"]
+            aux["moe_router_z"] = out["moe_router_z"]
         if train:
             aux["batch_stats"] = batch_stats
         else:
@@ -318,13 +341,19 @@ class Seq2SeqTask:
         return loss, aux
 
 
-def build_task(cfg: ExperimentConfig):
-    """Task registry keyed by model family."""
+def build_task(cfg: ExperimentConfig, mesh=None):
+    """Task registry keyed by model family.
+
+    ``mesh``: pass the trainer's Mesh when the model needs it at
+    construction time (the pipelined trunk's shard_map); tasks that don't
+    ignore it. When omitted, mesh-needing tasks build it from cfg.mesh —
+    correct as long as the caller does the same (build_mesh is
+    deterministic over jax.devices())."""
     name = cfg.model.name
     if name.startswith("resnet"):
         return ClassificationTask(cfg)
     if name.startswith("bert"):
-        return MlmTask(cfg)
+        return MlmTask(cfg, mesh=mesh)
     if name.startswith("transformer_nmt"):
         return Seq2SeqTask(cfg)
     if name.startswith("maskrcnn"):

@@ -1,0 +1,152 @@
+"""Pipeline parallelism (ops/pipeline.py, models/pipelined.py, 'pipe' axis).
+
+The reference has no pipeline parallelism (SURVEY.md §3.2 lists PP as
+absent); these tests hold the rebuild's extension to the same bar as
+TP/EP: the SPMD GPipe schedule is proven EXACT against a sequential
+application of the same stacked layers (forward and gradients), and the
+pipelined model is proven numerically invisible vs pure DP while its
+trunk params are asserted actually sharded over 'pipe'.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning_cfn_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimizerConfig,
+    ScheduleConfig,
+    TrainConfig,
+)
+from deeplearning_cfn_tpu.ops.pipeline import gpipe, scan_layers
+from deeplearning_cfn_tpu.parallel.mesh import build_mesh
+
+
+def _toy():
+    l, b, f = 8, 16, 4
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    params = {"w": jax.random.normal(k1, (l, f, f)) * 0.5,
+              "b": jax.random.normal(k2, (l, f)) * 0.1}
+    x = jax.random.normal(k3, (b, f))
+    stage = scan_layers(lambda lp, h: jnp.tanh(h @ lp["w"] + lp["b"]))
+    return params, x, stage
+
+
+def test_gpipe_forward_matches_sequential(devices):
+    """4 stages x 2 layers each over (pipe=4, data=2): bit-level same
+    result as scanning all 8 layers on one device."""
+    mesh = build_mesh(MeshConfig(data=2, pipe=4))
+    params, x, stage = _toy()
+    y_ref = stage(params, x)
+    y_pipe = jax.jit(lambda p, x: gpipe(
+        stage, p, x, mesh=mesh, n_microbatches=4))(params, x)
+    np.testing.assert_allclose(np.asarray(y_pipe), np.asarray(y_ref),
+                               atol=1e-6)
+
+
+def test_gpipe_gradients_match_sequential(devices):
+    """AD through the schedule (scan + ppermute transposes) reproduces the
+    sequential gradients for params AND inputs."""
+    mesh = build_mesh(MeshConfig(data=2, pipe=4))
+    params, x, stage = _toy()
+    ref = jax.grad(lambda p, x: jnp.sum(stage(p, x) ** 2),
+                   argnums=(0, 1))(params, x)
+    piped = jax.jit(jax.grad(
+        lambda p, x: jnp.sum(gpipe(stage, p, x, mesh=mesh,
+                                   n_microbatches=4) ** 2),
+        argnums=(0, 1)))(params, x)
+    for a, b in zip(jax.tree_util.tree_leaves(ref),
+                    jax.tree_util.tree_leaves(piped)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-5)
+
+
+def test_gpipe_passthrough_state(devices):
+    """Non-computed leaves (the attention-bias role) ride the pipeline
+    unchanged and come back intact."""
+    mesh = build_mesh(MeshConfig(data=2, pipe=4))
+    params, x, _ = _toy()
+    aux = jnp.arange(16.0).reshape(16, 1)
+
+    def stage(lp, st):
+        def step(s, layer_params):
+            h = jnp.tanh(s["h"] @ layer_params["w"] + layer_params["b"])
+            return {"h": h + 0.0 * s["aux"], "aux": s["aux"]}, None
+        out, _ = jax.lax.scan(step, st, lp)
+        return out
+
+    out = jax.jit(lambda p, xs: gpipe(stage, p, xs, mesh=mesh,
+                                      n_microbatches=4))(
+        params, {"h": x, "aux": aux})
+    np.testing.assert_allclose(np.asarray(out["aux"]), np.asarray(aux))
+
+
+def _run_pipelined(mesh_cfg, steps=10):
+    from deeplearning_cfn_tpu.data import build_pipeline
+    from deeplearning_cfn_tpu.train import create_train_state
+    from deeplearning_cfn_tpu.train.optim import build_optimizer, \
+        build_schedule
+    from deeplearning_cfn_tpu.train.task import build_task
+    from deeplearning_cfn_tpu.train.trainer import Trainer
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(name="bert_pipelined", num_classes=2,
+                          kwargs=dict(vocab_size=64, hidden_size=32,
+                                      num_layers=4, num_heads=2,
+                                      mlp_dim=64, max_len=32,
+                                      n_microbatches=4)),
+        data=DataConfig(name="wikipedia_mlm", seq_len=32, vocab_size=64,
+                        num_train_examples=256, prefetch=0),
+        train=TrainConfig(global_batch=32, dtype="float32"),
+        optimizer=OptimizerConfig(name="adamw", weight_decay=0.01),
+        schedule=ScheduleConfig(name="constant", base_lr=3e-3,
+                                warmup_steps=0),
+        mesh=mesh_cfg,
+    )
+    mesh = build_mesh(cfg.mesh)
+    task = build_task(cfg, mesh=mesh)
+    sched = build_schedule(cfg.schedule, 100, 32, 8)
+    tx = build_optimizer(cfg.optimizer, sched)
+    state = create_train_state(jax.random.PRNGKey(0), task.init, tx, mesh,
+                               param_rules=task.param_rules)
+    trainer = Trainer(cfg, task.loss_fn, tx, mesh=mesh, donate=False)
+    pipe = build_pipeline(cfg.data, 32, 2, seed=0, train=True)
+    it = pipe.epochs()
+    losses = []
+    for _ in range(steps):
+        batch = trainer.device_batch(next(it))
+        state, m = trainer.train_step(state, batch, jax.random.PRNGKey(1))
+        losses.append(float(m["loss"]))
+    return state, losses
+
+
+def test_pipeline_parallel_matches_data_parallel(devices):
+    """bert_pipelined trained 10 steps on a (pipe=2, data=4) mesh
+    reproduces the pure-DP (data=8) run — same loss trajectory, same final
+    params — while the stacked trunk weights are actually sharded over
+    'pipe'."""
+    state_pp, loss_pp = _run_pipelined(MeshConfig(data=4, pipe=2))
+    state_dp, loss_dp = _run_pipelined(MeshConfig(data=8))
+
+    n_sharded = 0
+    for leaf in jax.tree_util.tree_leaves(state_pp.params):
+        spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+        if spec is not None and len(spec) and spec[0] == "pipe":
+            n_sharded += 1
+            assert leaf.addressable_shards[0].data.shape[0] \
+                == leaf.shape[0] // 2
+    assert n_sharded == 16, \
+        f"expected all 16 stacked trunk params pipe-sharded, {n_sharded}"
+
+    np.testing.assert_allclose(loss_pp, loss_dp, rtol=2e-4)
+    # Params: atol 2e-3 — the pipelined trunk reduces attention/microbatch
+    # sums in a different order and 10 adamw steps accumulate that float32
+    # noise; anything semantic (wrong stage wiring, a dropped microbatch)
+    # is orders of magnitude larger AND caught by the loss check above and
+    # the bit-exact single-call tests further up.
+    for a, b in zip(jax.tree_util.tree_leaves(state_pp.params),
+                    jax.tree_util.tree_leaves(state_dp.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-3)
+    assert loss_pp[-1] < loss_pp[0]
