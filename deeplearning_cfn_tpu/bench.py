@@ -37,6 +37,7 @@ _UNITS = {
     "transformer_nmt_wmt": "sequences/sec/chip",
     "bert_moe_wikipedia": "sequences/sec/chip",
     "bert_pipelined_wikipedia": "sequences/sec/chip",
+    "bert_long_wikipedia": "sequences/sec/chip",
 }
 
 # Peak dense bf16 FLOPs/sec per chip, keyed by device_kind substring.

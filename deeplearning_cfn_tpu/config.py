@@ -33,6 +33,10 @@ class MeshConfig:
       pipe     — pipeline-parallel axis: stacked trunk layers shard their
                  leading layer dim over it and run the SPMD GPipe schedule
                  (ops/pipeline.py); batch stays replicated across 'pipe'.
+      seq      — sequence-parallel (long-context) axis: the bert_long
+                 model shards activations' sequence dim over it and runs
+                 ring or Ulysses all-to-all attention (ops/ring_attention,
+                 ops/ulysses).
       num_slices — multi-slice (DCN) scale-out: >1 builds a hybrid mesh
                  with an outer 'dcn_data' axis spanning slice boundaries.
                  Batch dim shards over (dcn_data, data) jointly; params stay
@@ -47,6 +51,7 @@ class MeshConfig:
     spatial: int = 1
     expert: int = 1
     pipe: int = 1
+    seq: int = 1
     num_slices: int = 1
 
 

@@ -22,7 +22,8 @@ def register_model(name: str):
 
 def build_model(name: str, num_classes: int, dtype, **kwargs):
     # Import model modules lazily so `import deeplearning_cfn_tpu` stays cheap.
-    from . import resnet, bert, transformer_nmt, maskrcnn, pipelined  # noqa: F401
+    from . import resnet, bert, transformer_nmt, maskrcnn, pipelined, \
+        bert_long  # noqa: F401
 
     if name not in _REGISTRY:
         raise KeyError(f"unknown model {name!r}; available: {sorted(_REGISTRY)}")
@@ -30,6 +31,7 @@ def build_model(name: str, num_classes: int, dtype, **kwargs):
 
 
 def list_models():
-    from . import resnet, bert, transformer_nmt, maskrcnn, pipelined  # noqa: F401
+    from . import resnet, bert, transformer_nmt, maskrcnn, pipelined, \
+        bert_long  # noqa: F401
 
     return sorted(_REGISTRY)

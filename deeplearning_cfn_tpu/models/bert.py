@@ -31,6 +31,34 @@ from .transformer import (
 PARAM_RULES = TRANSFORMER_PARAM_RULES + MOE_PARAM_RULES
 
 
+def mlm_nsp_heads(parent: nn.Module, x, token_emb, mlm_positions, *,
+                  vocab_size: int, hidden_size: int, num_classes: int,
+                  dtype) -> dict:
+    """The BERT pretraining heads, shared across the encoder variants
+    (plain / pipelined / long-context): MLM transform + tied-embedding
+    decoder over the masked positions, NSP tanh pooler over [CLS]. Must be
+    called from inside ``parent``'s ``@nn.compact`` __call__ — the
+    submodules attach to ``parent`` under the same names the original
+    inline implementation used."""
+    gathered = jnp.take_along_axis(
+        x, mlm_positions[:, :, None].astype(jnp.int32), axis=1)
+    h = nn.Dense(hidden_size, dtype=dtype,
+                 param_dtype=jnp.float32, name="mlm_transform")(gathered)
+    h = nn.gelu(h)
+    h = nn.LayerNorm(dtype=dtype, param_dtype=jnp.float32,
+                     name="mlm_norm")(h)
+    mlm_logits = token_emb.attend(h.astype(jnp.float32))
+    mlm_bias = parent.param("mlm_bias", nn.initializers.zeros_init(),
+                            (vocab_size,), jnp.float32)
+    mlm_logits = mlm_logits + mlm_bias
+    pooled = nn.tanh(nn.Dense(
+        hidden_size, dtype=jnp.float32, param_dtype=jnp.float32,
+        name="pooler")(x[:, 0, :].astype(jnp.float32)))
+    nsp_logits = nn.Dense(num_classes, dtype=jnp.float32,
+                          name="nsp_head")(pooled)
+    return {"mlm_logits": mlm_logits, "nsp_logits": nsp_logits}
+
+
 class BertEncoder(nn.Module):
     """``num_experts > 0`` turns every ``moe_every``-th layer into a
     Mixture-of-Experts layer (GShard's every-other-layer convention at the
@@ -115,27 +143,12 @@ class BertPretrain(nn.Module):
             moe_top_k=self.moe_top_k, name="encoder",
         )(input_ids, input_mask, segment_ids, deterministic=not train)
 
-        # MLM head on the masked positions only ([B,P] gather — static P).
-        gathered = jnp.take_along_axis(
-            x, mlm_positions[:, :, None].astype(jnp.int32), axis=1)
-        h = nn.Dense(self.hidden_size, dtype=self.dtype,
-                     param_dtype=jnp.float32, name="mlm_transform")(gathered)
-        h = nn.gelu(h)
-        h = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
-                         name="mlm_norm")(h)
-        # Tied output embedding (BERT's weight sharing) + output bias.
-        mlm_logits = token_emb.attend(h.astype(jnp.float32))
-        mlm_bias = self.param("mlm_bias", nn.initializers.zeros_init(),
-                              (self.vocab_size,), jnp.float32)
-        mlm_logits = mlm_logits + mlm_bias
-
-        # NSP head on the [CLS] (position 0) vector, tanh pooler as in BERT.
-        pooled = nn.tanh(nn.Dense(
-            self.hidden_size, dtype=jnp.float32, param_dtype=jnp.float32,
-            name="pooler")(x[:, 0, :].astype(jnp.float32)))
-        nsp_logits = nn.Dense(self.num_classes, dtype=jnp.float32,
-                              name="nsp_head")(pooled)
-        out = {"mlm_logits": mlm_logits, "nsp_logits": nsp_logits}
+        # MLM head on the masked positions only ([B,P] gather — static P),
+        # tied output embedding + NSP pooler (shared helper).
+        out = mlm_nsp_heads(self, x, token_emb, mlm_positions,
+                            vocab_size=self.vocab_size,
+                            hidden_size=self.hidden_size,
+                            num_classes=self.num_classes, dtype=self.dtype)
         if self.num_experts > 0:
             out["moe_load_balance"] = moe_aux["load_balance"]
             out["moe_router_z"] = moe_aux["router_z"]

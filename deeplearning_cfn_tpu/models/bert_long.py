@@ -1,0 +1,175 @@
+"""Long-context BERT: sequence-parallel attention over the 'seq' mesh axis.
+
+The reference's max sequence was BERT's 512, handled on-device (SURVEY.md
+§6 long-context row); this model is the rebuild's long-context entry
+(task contract: ring attention / all-to-all sequence parallelism as
+first-class citizens). Same task contract as models/bert.py BertPretrain
+(drop-in for MlmTask via model name "bert_long"), but every self-attention
+runs one of the two exact sequence-parallel strategies:
+
+- ``seq_impl="ring"``  — ops/ring_attention.py: K/V blocks rotate around
+  the 'seq' axis via ppermute, online-softmax accumulation, O(S_local)
+  memory, no head-count constraint;
+- ``seq_impl="ulysses"`` — ops/ulysses.py: two all-to-alls reswizzle
+  [B, H, S/N, D] -> [B, H/N, S, D] so each device runs ordinary
+  full-sequence flash attention for its head group (needs
+  num_heads % seq_ways == 0).
+
+Both are exact, so bert_long on (data=k, seq=n) reproduces (data=k*n)
+numerics — the equivalence test in tests/test_long_context.py.
+
+Packed-sequence contract: attention here takes NO padding bias — the
+long-context pretraining setup packs documents to full length, which is
+also what makes sequence sharding worthwhile. (A padding mask would have
+to be resharded alongside K/V blocks; the synthetic MLM source emits
+full-length sequences, matching the contract.) mlm_weights still mask the
+loss, so training semantics are unaffected.
+
+Non-attention compute (LayerNorm, FFN) is elementwise over the sequence,
+so activations carry a [batch('data'), seq('seq'), feature] sharding
+constraint between layers — only the attention op communicates.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import register_model
+from .transformer import Embed, Mlp, TRANSFORMER_PARAM_RULES
+from ..ops.ring_attention import ring_attention_sharded
+from ..ops.ulysses import ulysses_attention_sharded
+
+Dtype = Any
+PARAM_RULES = TRANSFORMER_PARAM_RULES
+
+
+class SeqParallelAttention(nn.Module):
+    """MultiHeadAttention with the core op swapped for a sequence-parallel
+    strategy (same projection names as transformer.MultiHeadAttention, so
+    the tensor-parallel PARAM_RULES compose)."""
+
+    num_heads: int
+    dtype: Dtype = jnp.bfloat16
+    dropout_rate: float = 0.0
+    seq_impl: str = "ring"
+    mesh: Any = None
+    batch_axes: Any = "data"
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        features = x.shape[-1]
+        if features % self.num_heads:
+            raise ValueError(f"hidden {features} % heads {self.num_heads}")
+        head_dim = features // self.num_heads
+        dense = lambda name: nn.Dense(
+            features, dtype=self.dtype, param_dtype=jnp.float32, name=name,
+            kernel_init=nn.initializers.xavier_uniform())
+
+        def split(t):
+            b, s, _ = t.shape
+            return t.reshape(b, s, self.num_heads, head_dim) \
+                .transpose(0, 2, 1, 3)
+
+        q = split(dense("query")(x))
+        k = split(dense("key")(x))
+        v = split(dense("value")(x))
+        seq_ways = (self.mesh.shape.get("seq", 1)
+                    if self.mesh is not None else 1)
+        if seq_ways > 1 and not self.is_initializing():
+            fn = {"ring": ring_attention_sharded,
+                  "ulysses": ulysses_attention_sharded}[self.seq_impl]
+            out = fn(q, k, v, self.mesh, axis_name="seq",
+                     batch_axis=self.batch_axes)
+        else:
+            from ..ops import fused_attention
+
+            out = fused_attention(q, k, v)
+        b, h, s, d = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        out = dense("attn_out")(out)
+        if self.dropout_rate > 0:
+            out = nn.Dropout(self.dropout_rate)(
+                out, deterministic=deterministic)
+        return out
+
+
+class LongBert(nn.Module):
+    """BertPretrain's contract with sequence-parallel attention."""
+
+    vocab_size: int
+    num_classes: int = 2
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 4096
+    dtype: Dtype = jnp.bfloat16
+    dropout_rate: float = 0.0
+    seq_impl: str = "ring"
+    mesh: Any = None
+    batch_axes: Any = "data"
+
+    def _constrain(self, x):
+        """Keep activations [batch('data'...), seq('seq'), feature] so the
+        elementwise layers run sharded and only attention communicates."""
+        if self.mesh is None or self.mesh.shape.get("seq", 1) <= 1 \
+                or self.is_initializing():
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P(self.batch_axes, "seq", None)))
+
+    @nn.compact
+    def __call__(self, input_ids, input_mask, segment_ids, mlm_positions,
+                 train: bool = True):
+        del input_mask  # packed-sequence contract: no padding bias (above)
+        deterministic = not train
+        x, token_emb = Embed(
+            self.vocab_size, self.hidden_size, self.max_len,
+            num_segments=2, dtype=self.dtype,
+            dropout_rate=self.dropout_rate, name="embed",
+        )(input_ids, segment_ids, deterministic=deterministic)
+        ln = lambda name: nn.LayerNorm(
+            dtype=self.dtype, param_dtype=jnp.float32, name=name)
+        for i in range(self.num_layers):
+            x = self._constrain(x)
+            # Post-LN block matching transformer.TransformerLayer's layout,
+            # with the sequence-parallel attention core.
+            attn = SeqParallelAttention(
+                self.num_heads, self.dtype, self.dropout_rate,
+                self.seq_impl, self.mesh, self.batch_axes,
+                name=f"layer_{i}_self_attn")
+            x = ln(f"layer_{i}_self_attn_norm")(
+                x + attn(x, deterministic=deterministic))
+            x = self._constrain(x)
+            mlp = Mlp(self.mlp_dim, self.dtype, self.dropout_rate,
+                      name=f"layer_{i}_mlp")
+            x = ln(f"layer_{i}_mlp_norm")(
+                x + mlp(x, deterministic=deterministic))
+        x = self._constrain(x)
+
+        from .bert import mlm_nsp_heads
+
+        return mlm_nsp_heads(self, x, token_emb, mlm_positions,
+                             vocab_size=self.vocab_size,
+                             hidden_size=self.hidden_size,
+                             num_classes=self.num_classes, dtype=self.dtype)
+
+
+@register_model("bert_long")
+def bert_long(num_classes: int = 2, dtype=jnp.bfloat16, *,
+              vocab_size: int = 30522, hidden_size: int = 768,
+              num_layers: int = 12, num_heads: int = 12,
+              mlp_dim: int = 3072, max_len: int = 4096,
+              dropout_rate: float = 0.0, seq_impl: str = "ring",
+              mesh=None, batch_axes="data"):
+    return LongBert(
+        vocab_size=vocab_size, num_classes=num_classes,
+        hidden_size=hidden_size, num_layers=num_layers,
+        num_heads=num_heads, mlp_dim=mlp_dim, max_len=max_len,
+        dtype=dtype, dropout_rate=dropout_rate, seq_impl=seq_impl,
+        mesh=mesh, batch_axes=batch_axes)

@@ -175,24 +175,12 @@ class PipelinedBert(nn.Module):
             self.batch_spec, name="pipe_stack",
         )(x, bias)
 
-        # Heads: same math as models/bert.py BertPretrain.
-        gathered = jnp.take_along_axis(
-            x, mlm_positions[:, :, None].astype(jnp.int32), axis=1)
-        h = nn.Dense(self.hidden_size, dtype=self.dtype,
-                     param_dtype=jnp.float32, name="mlm_transform")(gathered)
-        h = nn.gelu(h)
-        h = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
-                         name="mlm_norm")(h)
-        mlm_logits = token_emb.attend(h.astype(jnp.float32))
-        mlm_bias = self.param("mlm_bias", nn.initializers.zeros_init(),
-                              (self.vocab_size,), jnp.float32)
-        mlm_logits = mlm_logits + mlm_bias
-        pooled = nn.tanh(nn.Dense(
-            self.hidden_size, dtype=jnp.float32, param_dtype=jnp.float32,
-            name="pooler")(x[:, 0, :].astype(jnp.float32)))
-        nsp_logits = nn.Dense(self.num_classes, dtype=jnp.float32,
-                              name="nsp_head")(pooled)
-        return {"mlm_logits": mlm_logits, "nsp_logits": nsp_logits}
+        from .bert import mlm_nsp_heads
+
+        return mlm_nsp_heads(self, x, token_emb, mlm_positions,
+                             vocab_size=self.vocab_size,
+                             hidden_size=self.hidden_size,
+                             num_classes=self.num_classes, dtype=self.dtype)
 
 
 @register_model("bert_pipelined")

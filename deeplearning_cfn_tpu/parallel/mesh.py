@@ -29,7 +29,7 @@ from ..config import MeshConfig
 # grad psums per hop, but batch shards ride it too), 'model' innermost so
 # tensor-parallel collectives ride the shortest ICI hops.
 AXIS_ORDER: Tuple[str, ...] = ("dcn_data", "pipe", "data", "expert",
-                               "spatial", "model")
+                               "spatial", "seq", "model")
 # Batch dim 0 shards over all of these jointly: the 'expert' axis carries
 # batch shards outside MoE layers (GSPMD MoE — tokens are data-parallel
 # everywhere except the expert einsums, where the stacked expert weights
@@ -49,16 +49,18 @@ class MeshSpec:
     dcn_data: int = 1
     expert: int = 1
     pipe: int = 1
+    seq: int = 1
 
     @property
     def num_devices(self) -> int:
         return (self.data * self.model * self.spatial * self.dcn_data
-                * self.expert * self.pipe)
+                * self.expert * self.pipe * self.seq)
 
     def axis_sizes(self) -> Dict[str, int]:
         return {"dcn_data": self.dcn_data, "pipe": self.pipe,
                 "data": self.data, "expert": self.expert,
-                "spatial": self.spatial, "model": self.model}
+                "spatial": self.spatial, "seq": self.seq,
+                "model": self.model}
 
     @classmethod
     def resolve(cls, cfg: MeshConfig, num_devices: int) -> "MeshSpec":
@@ -69,18 +71,19 @@ class MeshSpec:
         spatial = cfg.spatial
         expert = getattr(cfg, "expert", 1)
         pipe = getattr(cfg, "pipe", 1)
+        seq = getattr(cfg, "seq", 1)
         slices = getattr(cfg, "num_slices", 1)
-        if min(model, spatial, slices, expert, pipe) < 1:
+        if min(model, spatial, slices, expert, pipe, seq) < 1:
             raise ValueError(f"mesh axes must be >=1, got {cfg}")
         if num_devices % slices != 0:
             raise ValueError(
                 f"num_slices={slices} does not divide device count "
                 f"{num_devices}")
         per_slice = num_devices // slices
-        fixed = model * spatial * expert * pipe
+        fixed = model * spatial * expert * pipe * seq
         if per_slice % fixed != 0:
             raise ValueError(
-                f"pipe*model*spatial*expert={fixed} does not divide "
+                f"pipe*model*spatial*seq*expert={fixed} does not divide "
                 f"per-slice device count {per_slice}"
             )
         data = cfg.data
@@ -88,11 +91,11 @@ class MeshSpec:
             data = per_slice // fixed
         if data * fixed != per_slice:
             raise ValueError(
-                f"mesh {pipe}x{data}x{expert}x{spatial}x{model} != "
+                f"mesh {pipe}x{data}x{expert}x{spatial}x{seq}x{model} != "
                 f"{per_slice} devices/slice; set data=-1 to auto-size"
             )
         return cls(data=data, model=model, spatial=spatial,
-                   dcn_data=slices, expert=expert, pipe=pipe)
+                   dcn_data=slices, expert=expert, pipe=pipe, seq=seq)
 
 
 def build_mesh(

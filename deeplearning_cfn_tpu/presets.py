@@ -178,6 +178,35 @@ def _bert_pipelined() -> ExperimentConfig:
     )
 
 
+@register_preset("bert_long_wikipedia")
+def _bert_long() -> ExperimentConfig:
+    """Long-context BERT: sequence 4096 with ring attention over a 'seq'
+    mesh axis (models/bert_long.py) — the long-context flagship. No
+    reference equivalent (its max sequence was BERT's 512 — SURVEY.md §6);
+    packed-sequence contract (no padding bias). Switch strategy with
+    model.kwargs.seq_impl=ulysses (needs heads % seq ways == 0)."""
+    return ExperimentConfig(
+        model=ModelConfig(
+            name="bert_long",
+            num_classes=2,
+            kwargs=dict(
+                hidden_size=768, num_layers=12, num_heads=12, mlp_dim=3072,
+                max_len=4096, seq_impl="ring",
+            ),
+        ),
+        data=DataConfig(name="wikipedia_mlm", seq_len=4096,
+                        vocab_size=30522),
+        train=TrainConfig(global_batch=256, steps=100_000, dtype="bfloat16",
+                          shard_opt_state=True),
+        optimizer=OptimizerConfig(name="lamb", weight_decay=0.01,
+                                  grad_clip_norm=1.0),
+        schedule=ScheduleConfig(name="cosine", base_lr=6e-4,
+                                warmup_steps=3000),
+        mesh=MeshConfig(data=-1, seq=4),
+        stack=StackConfig(slice_type="v5p-64"),
+    )
+
+
 @register_preset("maskrcnn_coco")
 def _maskrcnn() -> ExperimentConfig:
     """Mask R-CNN COCO — the one beyond-DP config: pjit data+spatial shard

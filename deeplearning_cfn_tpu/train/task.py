@@ -162,16 +162,23 @@ class MlmTask:
         kwargs = dict(cfg.model.kwargs)
         kwargs.setdefault("vocab_size", cfg.data.vocab_size)
         kwargs.setdefault("max_len", max(cfg.data.seq_len, 128))
-        if cfg.model.name == "bert_pipelined":
-            # The pipelined trunk runs shard_map over the mesh; give it the
+        if cfg.model.name in ("bert_pipelined", "bert_long"):
+            # These trunks run shard_map over the mesh; give them the
             # trainer's mesh and the batch-dim spec the trainer will feed.
-            from ..models.pipelined import PARAM_RULES
             from ..parallel.mesh import build_mesh
             from ..parallel.sharding import batch_sharding
 
             mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
             kwargs.setdefault("mesh", mesh)
-            kwargs.setdefault("batch_spec", batch_sharding(mesh, 1).spec[0])
+            spec0 = batch_sharding(mesh, 1).spec[0]
+            if cfg.model.name == "bert_pipelined":
+                from ..models.pipelined import PARAM_RULES
+
+                kwargs.setdefault("batch_spec", spec0)
+            else:
+                from ..models.bert_long import PARAM_RULES
+
+                kwargs.setdefault("batch_axes", spec0)
         else:
             from ..models.bert import PARAM_RULES
         self.param_rules = PARAM_RULES

@@ -37,7 +37,7 @@ def test_meshspec_resolve_rejects_bad_shapes():
 def test_build_mesh_axes(devices):
     mesh = build_mesh(MeshConfig(data=-1, model=2, spatial=2))
     assert mesh.shape == {"dcn_data": 1, "pipe": 1, "data": 2, "expert": 1,
-                          "spatial": 2, "model": 2}
+                          "spatial": 2, "seq": 1, "model": 2}
     assert mesh.devices.size == 8
     assert "mesh[" in describe(mesh)
 
@@ -89,7 +89,7 @@ def test_build_mesh_multi_slice(devices):
     boundaries and the batch dim shards over both data axes jointly."""
     mesh = build_mesh(MeshConfig(data=-1, num_slices=2))
     assert mesh.shape == {"dcn_data": 2, "pipe": 1, "data": 4, "expert": 1,
-                          "spatial": 1, "model": 1}
+                          "spatial": 1, "seq": 1, "model": 1}
     sh = batch_sharding(mesh, 2)
     assert sh.spec == P(("dcn_data", "data"), None)
     x = np.zeros((16, 4), np.float32)
