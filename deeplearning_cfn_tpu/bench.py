@@ -133,7 +133,9 @@ def run_bench(
         # sweep.
         per_chip = {"imagenet_resnet50": 512, "cifar10_resnet20": 512,
                     "bert_base_wikipedia": 32, "transformer_nmt_wmt": 64,
-                    "maskrcnn_coco": 4}.get(preset, 64)
+                    "maskrcnn_coco": 4,
+                    # seq-4096 activations: batch 8 fits one 16 GB chip
+                    "bert_long_wikipedia": 8}.get(preset, 64)
         cfg.train.global_batch = per_chip
     apply_overrides(cfg, ["data.prefetch=0", "data.synthetic=true"])
     # One batch is all the bench consumes — don't materialize the default
@@ -211,6 +213,11 @@ def run_bench(
         "n_chips": n_chips,
         "mean_step_s": round(mean_step_s, 5),
         "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+        # The mesh the step actually ran on. On one chip every preset
+        # degenerates to {data: 1} — in particular bert_long then runs its
+        # DENSE flash-attention fallback, not ring/Ulysses (those need a
+        # seq axis > 1); the mesh field keeps that visible in the artifact.
+        "mesh": {k: int(v) for k, v in mesh.shape.items()},
         "measured": True,
     }
 

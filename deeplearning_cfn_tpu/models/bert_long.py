@@ -40,7 +40,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import register_model
-from .transformer import Embed, Mlp, TRANSFORMER_PARAM_RULES
+from .transformer import Embed, Mlp, MultiHeadAttention, \
+    TRANSFORMER_PARAM_RULES
 from ..ops.ring_attention import ring_attention_sharded
 from ..ops.ulysses import ulysses_attention_sharded
 
@@ -48,54 +49,27 @@ Dtype = Any
 PARAM_RULES = TRANSFORMER_PARAM_RULES
 
 
-class SeqParallelAttention(nn.Module):
-    """MultiHeadAttention with the core op swapped for a sequence-parallel
-    strategy (same projection names as transformer.MultiHeadAttention, so
-    the tensor-parallel PARAM_RULES compose)."""
+class SeqParallelAttention(MultiHeadAttention):
+    """MultiHeadAttention with ``core_attention`` swapped for a
+    sequence-parallel strategy; projections/names are inherited, so the
+    tensor-parallel PARAM_RULES compose and any change to the shared
+    projection block applies to both attention variants."""
 
-    num_heads: int
-    dtype: Dtype = jnp.bfloat16
-    dropout_rate: float = 0.0
     seq_impl: str = "ring"
     mesh: Any = None
     batch_axes: Any = "data"
 
-    @nn.compact
-    def __call__(self, x, deterministic=True):
-        features = x.shape[-1]
-        if features % self.num_heads:
-            raise ValueError(f"hidden {features} % heads {self.num_heads}")
-        head_dim = features // self.num_heads
-        dense = lambda name: nn.Dense(
-            features, dtype=self.dtype, param_dtype=jnp.float32, name=name,
-            kernel_init=nn.initializers.xavier_uniform())
-
-        def split(t):
-            b, s, _ = t.shape
-            return t.reshape(b, s, self.num_heads, head_dim) \
-                .transpose(0, 2, 1, 3)
-
-        q = split(dense("query")(x))
-        k = split(dense("key")(x))
-        v = split(dense("value")(x))
+    def core_attention(self, q, k, v, bias, causal):
+        assert bias is None and not causal, \
+            "sequence-parallel attention is the packed, non-causal contract"
         seq_ways = (self.mesh.shape.get("seq", 1)
                     if self.mesh is not None else 1)
         if seq_ways > 1 and not self.is_initializing():
             fn = {"ring": ring_attention_sharded,
                   "ulysses": ulysses_attention_sharded}[self.seq_impl]
-            out = fn(q, k, v, self.mesh, axis_name="seq",
-                     batch_axis=self.batch_axes)
-        else:
-            from ..ops import fused_attention
-
-            out = fused_attention(q, k, v)
-        b, h, s, d = out.shape
-        out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
-        out = dense("attn_out")(out)
-        if self.dropout_rate > 0:
-            out = nn.Dropout(self.dropout_rate)(
-                out, deterministic=deterministic)
-        return out
+            return fn(q, k, v, self.mesh, axis_name="seq",
+                      batch_axis=self.batch_axes)
+        return super().core_attention(q, k, v, None, False)
 
 
 class LongBert(nn.Module):
@@ -140,8 +114,9 @@ class LongBert(nn.Module):
             # Post-LN block matching transformer.TransformerLayer's layout,
             # with the sequence-parallel attention core.
             attn = SeqParallelAttention(
-                self.num_heads, self.dtype, self.dropout_rate,
-                self.seq_impl, self.mesh, self.batch_axes,
+                num_heads=self.num_heads, dtype=self.dtype,
+                dropout_rate=self.dropout_rate, seq_impl=self.seq_impl,
+                mesh=self.mesh, batch_axes=self.batch_axes,
                 name=f"layer_{i}_self_attn")
             x = ln(f"layer_{i}_self_attn_norm")(
                 x + attn(x, deterministic=deterministic))

@@ -54,6 +54,13 @@ class MultiHeadAttention(nn.Module):
     dropout_rate: float = 0.0
     attention_impl: str = "auto"
 
+    def core_attention(self, q, k, v, bias, causal):
+        """The [B,H,S,D] attention op. Subclasses swap this for a
+        distributed strategy (SeqParallelAttention) while inheriting the
+        projections/KV-cache/dropout plumbing unchanged."""
+        return fused_attention(q, k, v, bias=bias, causal=causal,
+                               implementation=self.attention_impl)
+
     @nn.compact
     def __call__(self, x, kv=None, bias=None, causal=False,
                  deterministic=True, decode=False,
@@ -109,8 +116,7 @@ class MultiHeadAttention(nn.Module):
             out = fused_attention(q, ck.value, cv.value, bias=step_bias,
                                   causal=False, implementation="reference")
         else:
-            out = fused_attention(q, k, v, bias=bias, causal=causal,
-                                  implementation=self.attention_impl)
+            out = self.core_attention(q, k, v, bias, causal)
         b, h, s, d = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
         out = dense("attn_out")(out)

@@ -7,12 +7,15 @@ SURVEY.md §3.3 "cuDNN / framework kernels"). Design:
   numerics oracle and the CPU/GPU fallback. XLA fuses this well already;
   the flash kernel's win is avoiding the [S,S] materialization in HBM.
 - ``_flash_forward``: Pallas TPU kernel, online-softmax blocked over the KV
-  sequence (flash attention). Grid is (batch, heads, Q blocks); K/V live in
-  VMEM whole (fine to ~16k tokens at d=64; long-context beyond that is the
-  ring-attention path in ring_attention.py).
-- ``_flash_backward``: FlashAttention-2-style blocked dq/dk/dv kernels —
-  the forward saves only O and the per-row logsumexp, the backward
-  recomputes P per block, so training memory is O(S) too (bias-free path).
+  sequence (flash attention). Grid is (batch, heads, Q blocks, KV blocks)
+  with the KV axis innermost: running (m, l, acc) stats live in VMEM
+  scratch and every operand is block-mapped, so per-step VMEM is O(block)
+  — sequence length is bounded by HBM, not VMEM (cross-host long-context
+  is the ring-attention path in ring_attention.py).
+- ``_flash_backward``: FlashAttention-2-style blocked dq/dk/dv kernels with
+  the same grid-accumulation structure — the forward saves only O and the
+  per-row logsumexp, the backward recomputes P per block, so training
+  memory is O(S) too (bias-free path).
 - ``fused_attention``: public entry — dispatches to the kernels on TPU,
   reference elsewhere. With a bias, the backward falls back to the
   reference VJP (a trainable bias's cotangent is [Sq,Sk]-shaped anyway).
@@ -32,10 +35,24 @@ import jax.numpy as jnp
 
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax NaN-free
 
-# Flash kernel tiling. 128 matches the MXU/VPU lane width; q blocks of 256
-# amortize the loop while staying well inside VMEM.
-_BLOCK_Q = 256
-_BLOCK_K = 128
+# Flash kernel tiling. Swept on a real v5e chip (2026-07-31, BERT-shaped
+# d=64 cases at S in {512, 1024, 2048, 8192}): 1024x1024 beat the initial
+# 256x128 by 1.3-4.7x fwd+bwd — bigger tiles amortize the d=64 contraction
+# (half the MXU's 128 depth) over more rows/columns and cut grid overhead.
+# The f32 score tile (BQ x BK = 4 MB) plus operand blocks stays inside the
+# 16 MB scoped-VMEM budget; short sequences clamp to ceil8(S) anyway.
+_BLOCK_Q = 1024
+_BLOCK_K = 1024
+# Row statistics (logsumexp, delta) are stored lane-replicated with a
+# trailing dim of 8: Mosaic requires a block's last two dims to be
+# (divisible by 8, divisible by 128) or equal to the array's — a bare
+# [..., block_q] row vector satisfies neither on real hardware (it only
+# works in interpret mode, which skips the check).
+_STAT_LANES = 8
+
+
+def _ceil8(n: int) -> int:
+    return max(8, -(-n // 8) * 8)
 
 
 # ---------------------------------------------------------------------------
@@ -74,47 +91,49 @@ def attention_reference(
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
-                  causal: bool, sm_scale: float, block_k: int, seq_k: int,
-                  seq_q: int):
-    """One (batch, head, q-block) program: online softmax over KV blocks.
+def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                  m_scr, l_scr, acc_scr, *,
+                  causal: bool, sm_scale: float, seq_k: int, seq_q: int):
+    """One (batch, head, q-block, kv-block) grid step of the online softmax.
+
+    The kv-block axis is the innermost ("arbitrary") grid dimension: the
+    (m, l, acc) running statistics live in VMEM scratch that persists
+    across those steps, and the output block (indexed by the q block only)
+    is written once, on the last kv step. Every operand is block-mapped —
+    per-step VMEM is O(block), independent of sequence length, which is
+    what lets the same kernel serve seq-512 BERT and seq-32k long-context.
+    (An earlier design held K/V whole in VMEM and looped inside the
+    kernel; it hit Mosaic's scoped-vmem limit at long S.)
 
     ``seq_q``/``seq_k`` are the TRUE (unpadded) lengths — the causal
-    diagonal aligns their ends; the refs hold the block-padded arrays.
-    Refs arrive with the leading (1, 1) batch/head block dims squeezed via
-    indexing; accumulation is f32 in VMEM registers (m, l, acc carried
-    through the fori_loop), written once at the end — the [S,S] score matrix
-    never exists in HBM.
+    diagonal aligns their ends; the refs hold block-padded arrays. The
+    [S,S] score matrix never exists in HBM.
     """
     from jax.experimental import pallas as pl  # deferred: TPU-only path
 
     block_q = q_ref.shape[-2]
-    d = q_ref.shape[-1]
+    block_k = k_ref.shape[-2]
     iq = pl.program_id(2)
+    kb = pl.program_id(3)
+    num_kb = pl.num_programs(3)
 
-    q = q_ref[0, 0, :, :].astype(jnp.float32) * sm_scale
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    num_kb = k_ref.shape[-2] // block_k  # padded block count
-    if causal:
-        # Skip KV blocks entirely above the diagonal for this q block
-        # (true positions: padded k columns lie above it by construction).
-        q_end = (iq + 1) * block_q + (seq_k - seq_q)
-        num_kb_live = jnp.minimum((q_end + block_k - 1) // block_k, num_kb)
-    else:
-        num_kb_live = num_kb
-
-    def body(kb, carry):
-        m_prev, l_prev, acc_prev = carry
-        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
-        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+    def _accumulate():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * sm_scale
+        k_blk = k_ref[0, 0, :, :]
+        v_blk = v_ref[0, 0, :, :]
         s = jax.lax.dot_general(
             q, k_blk.astype(jnp.float32),
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]
         if bias_ref is not None:
-            s = s + bias_ref[0, 0, :, pl.ds(kb * block_k, block_k)] \
-                .astype(jnp.float32)
+            s = s + bias_ref[0, 0, :, :].astype(jnp.float32)
         if causal:
             q_pos = jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0) + iq * block_q \
@@ -122,38 +141,51 @@ def _flash_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
             k_pos = jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1) + kb * block_k
             s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)  # [block_q, 1]
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc_prev * alpha + jax.lax.dot_general(
+        acc_new = acc_scr[...] * alpha + jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l_new, acc_new
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+        acc_scr[...] = acc_new
 
-    init = (
-        jnp.full((block_q, 1), _NEG_INF, jnp.float32),
-        jnp.zeros((block_q, 1), jnp.float32),
-        jnp.zeros((block_q, d), jnp.float32),
-    )
-    m, l, acc = jax.lax.fori_loop(0, num_kb_live, body, init)
-    # Guard divide-by-zero for rows that saw no KV block at all (only the
-    # padded tail rows of the last q block, which the caller slices off;
-    # -1e30-bias "masked" rows still have l > 0 and softmax normally).
-    out = acc / jnp.maximum(l, 1e-30)
-    o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
-    if lse_ref is not None:
-        # Per-row logsumexp of the SCALED logits — the statistic the flash
-        # backward needs to rebuild P without a second online softmax.
-        # Rows that saw nothing (padded tail) get +LARGE so the backward's
-        # exp(s - lse) underflows to exactly 0 for them.
-        lse = jnp.where(l[:, 0] > 0,
-                        m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-37)),
-                        -_NEG_INF)
-        lse_ref[0, 0, :] = lse.astype(jnp.float32)
+    if causal:
+        # Whole kv block above the diagonal for every row of this q block
+        # (true positions; padded k columns lie above it by construction):
+        # skip the matmuls entirely — the DMA still happens, the FLOPs not.
+        q_end = (iq + 1) * block_q + (seq_k - seq_q)
+        pl.when(kb * block_k < q_end)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        m = m_scr[:, :1]
+        l = l_scr[:, :1]
+        # Guard divide-by-zero for rows that saw no KV block at all (only
+        # the padded tail rows of the last q block, which the caller slices
+        # off; -1e30-bias "masked" rows still have l > 0 and softmax
+        # normally).
+        o_ref[0, 0, :, :] = (acc_scr[...] / jnp.maximum(l, 1e-30)) \
+            .astype(o_ref.dtype)
+        if lse_ref is not None:
+            # Per-row logsumexp of the SCALED logits — the statistic the
+            # flash backward needs to rebuild P without a second online
+            # softmax. Rows that saw nothing (padded tail) get +LARGE so
+            # the backward's exp(s - lse) underflows to exactly 0 for
+            # them. Stored lane-replicated (see _STAT_LANES).
+            lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-37)),
+                            -_NEG_INF)  # [block_q, 1]
+            lse_ref[0, 0, :, :] = jnp.broadcast_to(
+                lse, lse_ref.shape[2:]).astype(jnp.float32)
 
 
 def _pad_to(x: jnp.ndarray, axis: int, multiple: int) -> jnp.ndarray:
@@ -173,8 +205,9 @@ def _flash_forward(q, k, v, bias, causal, sm_scale, interpret=False,
 
     b, h, sq, d = q.shape
     sk = k.shape[-2]
-    block_q = min(_BLOCK_Q, max(8, sq))
-    block_k = min(_BLOCK_K, max(8, sk))
+    # Multiples of 8 (the f32 sublane count) — Mosaic's block-shape rule.
+    block_q = min(_BLOCK_Q, _ceil8(sq))
+    block_k = min(_BLOCK_K, _ceil8(sk))
 
     qp = _pad_to(q, 2, block_q)
     kp = _pad_to(k, 2, block_k)
@@ -205,16 +238,18 @@ def _flash_forward(q, k, v, bias, causal, sm_scale, interpret=False,
         bias = pad_bias if bias is None else bias + pad_bias
 
     in_specs = [
-        pl.BlockSpec((1, 1, block_q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
-        pl.BlockSpec((1, 1, sk_p, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
-        pl.BlockSpec((1, 1, sk_p, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda ib, ih, iq, ik: (ib, ih, ik, 0)),
     ]
     args = [qp, kp, vp]
     # The causal diagonal is defined by the TRUE lengths (ends aligned, as
     # in attention_reference); padded q rows are sliced off at the end and
     # padded k columns sit above the diagonal, so neither corrupts it.
-    kernel_kw = dict(causal=causal, sm_scale=sm_scale, block_k=block_k,
-                     seq_k=sk, seq_q=sq)
+    kernel_kw = dict(causal=causal, sm_scale=sm_scale, seq_k=sk, seq_q=sq)
     if bias is not None:
         # Keep broadcast dims at size 1 (indexed with block 0) instead of
         # materializing [B,H,Sq,Sk] in HBM.
@@ -223,43 +258,54 @@ def _flash_forward(q, k, v, bias, causal, sm_scale, interpret=False,
             bias = _pad_to(bias, 2, block_q)
         block_bq = block_q if bq > 1 else 1
         in_specs.append(pl.BlockSpec(
-            (1, 1, block_bq, sk_p),
-            lambda ib, ih, iq: (ib if bb > 1 else 0, ih if bh > 1 else 0,
-                                iq if bq > 1 else 0, 0)))
+            (1, 1, block_bq, block_k),
+            lambda ib, ih, iq, ik: (ib if bb > 1 else 0,
+                                    ih if bh > 1 else 0,
+                                    iq if bq > 1 else 0, ik)))
         args.append(bias)
 
-        def kernel(q_ref, k_ref, v_ref, b_ref, o_ref, *maybe_lse):
-            _flash_kernel(q_ref, k_ref, v_ref, b_ref, o_ref,
-                          maybe_lse[0] if maybe_lse else None, **kernel_kw)
+        def kernel(q_ref, k_ref, v_ref, b_ref, o_ref, *rest):
+            # rest = (lse_ref if return_stats) + 3 scratch refs
+            lse = rest[0] if return_stats else None
+            _flash_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse,
+                          *rest[-3:], **kernel_kw)
     else:
-        def kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse):
-            _flash_kernel(q_ref, k_ref, v_ref, None, o_ref,
-                          maybe_lse[0] if maybe_lse else None, **kernel_kw)
+        def kernel(q_ref, k_ref, v_ref, o_ref, *rest):
+            lse = rest[0] if return_stats else None
+            _flash_kernel(q_ref, k_ref, v_ref, None, o_ref, lse,
+                          *rest[-3:], **kernel_kw)
 
     out_specs = pl.BlockSpec((1, 1, block_q, d),
-                             lambda ib, ih, iq: (ib, ih, iq, 0))
+                             lambda ib, ih, iq, ik: (ib, ih, iq, 0))
     out_shape = jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype)
     if return_stats:
         out_specs = [out_specs,
-                     pl.BlockSpec((1, 1, block_q),
-                                  lambda ib, ih, iq: (ib, ih, iq))]
+                     pl.BlockSpec((1, 1, block_q, _STAT_LANES),
+                                  lambda ib, ih, iq, ik: (ib, ih, iq, 0))]
         out_shape = [out_shape,
-                     jax.ShapeDtypeStruct((b, h, sq_p), jnp.float32)]
+                     jax.ShapeDtypeStruct((b, h, sq_p, _STAT_LANES),
+                                          jnp.float32)]
 
     result = pl.pallas_call(
         kernel,
-        grid=(b, h, sq_p // block_q),
+        grid=(b, h, sq_p // block_q, sk_p // block_k),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),  # m
+            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),  # l
+            pltpu.VMEM((block_q, d), jnp.float32),            # acc
+        ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
         ) if not interpret else None,
         interpret=interpret,
     )(*args)
     if return_stats:
         out, lse = result
-        return out[:, :, :sq, :], lse[:, :, :sq]
+        return out[:, :, :sq, :], lse[:, :, :sq, 0]
     return result[:, :, :sq, :]
 
 
@@ -294,99 +340,109 @@ def _bwd_mask(s, iq_block, ik_block, block_q, block_k, causal, seq_q, seq_k):
 
 
 def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                           dk_ref, dv_ref, *, causal, sm_scale, block_q,
-                           seq_q, seq_k):
+                           dk_ref, dv_ref, dk_scr, dv_scr, *, causal,
+                           sm_scale, seq_q, seq_k):
+    """One (batch, head, kv-block, q-block) grid step: accumulate this q
+    block's contribution to dK/dV of one kv block in VMEM scratch; write on
+    the last q step. Same block-mapped structure as the forward kernel."""
     from jax.experimental import pallas as pl
 
     ik = pl.program_id(2)
+    qi = pl.program_id(3)
+    num_qb = pl.num_programs(3)
+    block_q = q_ref.shape[-2]
     block_k = k_ref.shape[-2]
-    d = q_ref.shape[-1]
-    num_qb = q_ref.shape[-2] // block_q
 
-    k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
-    v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    if causal:
-        # First q block whose last row reaches this kv block's first column.
-        first_live = (ik * block_k - (seq_k - seq_q)) // block_q
-        qb_lo = jnp.maximum(first_live, 0)
-    else:
-        qb_lo = 0
-
-    def body(qi, carry):
-        dk_acc, dv_acc = carry
-        q_blk = q_ref[0, 0, pl.ds(qi * block_q, block_q), :] \
-            .astype(jnp.float32)
-        do_blk = do_ref[0, 0, pl.ds(qi * block_q, block_q), :] \
-            .astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+    def _accumulate():
+        k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
+        q_blk = q_ref[0, 0, :, :].astype(jnp.float32)
+        do_blk = do_ref[0, 0, :, :].astype(jnp.float32)
+        # Stats are lane-replicated [rows, _STAT_LANES]; one column
+        # suffices.
+        lse = lse_ref[0, 0, :, :][:, :1]
+        delta = delta_ref[0, 0, :, :][:, :1]
         s = jax.lax.dot_general(
             q_blk, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         s = _bwd_mask(s, qi, ik, block_q, block_k, causal, seq_q, seq_k)
-        p = jnp.exp(s - lse[:, None])  # [bq, bk]; 0 for masked/padded rows
-        dv_acc = dv_acc + jax.lax.dot_general(
+        p = jnp.exp(s - lse)  # [bq, bk]; 0 for masked/padded rows
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
             p, do_blk, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do_blk, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        dk_acc = dk_acc + sm_scale * jax.lax.dot_general(
+        ds = p * (dp - delta)
+        dk_scr[...] = dk_scr[...] + sm_scale * jax.lax.dot_general(
             ds, q_blk, dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk_acc, dv_acc
 
-    init = (jnp.zeros((block_k, d), jnp.float32),
-            jnp.zeros((block_k, d), jnp.float32))
-    dk, dv = jax.lax.fori_loop(qb_lo, num_qb, body, init)
-    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+    if causal:
+        # Live iff this q block's last row reaches this kv block's first
+        # column (ends-aligned true positions) — else skip the matmuls.
+        pl.when((qi + 1) * block_q + (seq_k - seq_q) > ik * block_k)(
+            _accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(qi == num_qb - 1)
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, causal, sm_scale, block_k, seq_q,
+                         dq_ref, dq_scr, *, causal, sm_scale, seq_q,
                          seq_k):
+    """One (batch, head, q-block, kv-block) grid step: accumulate one kv
+    block's contribution to dQ of one q block; write on the last kv step."""
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(2)
+    kb = pl.program_id(3)
+    num_kb = pl.num_programs(3)
     block_q = q_ref.shape[-2]
-    d = q_ref.shape[-1]
-    num_kb = k_ref.shape[-2] // block_k
+    block_k = k_ref.shape[-2]
 
-    q_blk = q_ref[0, 0, :, :].astype(jnp.float32)
-    do_blk = do_ref[0, 0, :, :].astype(jnp.float32)
-    lse = lse_ref[0, 0, :]
-    delta = delta_ref[0, 0, :]
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    if causal:
-        q_end = (iq + 1) * block_q + (seq_k - seq_q)
-        num_kb_live = jnp.minimum((q_end + block_k - 1) // block_k, num_kb)
-    else:
-        num_kb_live = num_kb
-
-    def body(kb, dq_acc):
-        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :] \
-            .astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :] \
-            .astype(jnp.float32)
+    def _accumulate():
+        q_blk = q_ref[0, 0, :, :].astype(jnp.float32)
+        do_blk = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :][:, :1]
+        delta = delta_ref[0, 0, :, :][:, :1]
+        k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q_blk, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         s = _bwd_mask(s, iq, kb, block_q, block_k, causal, seq_q, seq_k)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do_blk, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        return dq_acc + sm_scale * jax.lax.dot_general(
+        ds = p * (dp - delta)
+        dq_scr[...] = dq_scr[...] + sm_scale * jax.lax.dot_general(
             ds, k_blk, dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, num_kb_live, body,
-                           jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+    if causal:
+        pl.when(kb * block_k < (iq + 1) * block_q + (seq_k - seq_q))(
+            _accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, interpret):
@@ -396,8 +452,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, interpret):
 
     b, h, sq, d = q.shape
     sk = k.shape[-2]
-    block_q = min(_BLOCK_Q, max(8, sq))
-    block_k = min(_BLOCK_K, max(8, sk))
+    block_q = min(_BLOCK_Q, _ceil8(sq))
+    block_k = min(_BLOCK_K, _ceil8(sk))
 
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
 
@@ -412,43 +468,53 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, interpret):
         lse_p = jnp.where(pad_rows[None, None, :], -_NEG_INF, lse_p)
     delta_p = _pad_to(delta, 2, block_q)
     sq_p, sk_p = qp.shape[2], kp.shape[2]
+    # Lane-replicate the row stats (see _STAT_LANES): a [..., rows] array
+    # cannot be block-mapped on real hardware.
+    lse_p = jnp.broadcast_to(lse_p[..., None], (b, h, sq_p, _STAT_LANES))
+    delta_p = jnp.broadcast_to(delta_p[..., None], (b, h, sq_p, _STAT_LANES))
 
     common = dict(causal=causal, sm_scale=sm_scale, seq_q=sq, seq_k=sk)
-    q_spec = pl.BlockSpec((1, 1, sq_p, d), lambda ib, ih, i: (ib, ih, 0, 0))
-    row_spec = pl.BlockSpec((1, 1, sq_p), lambda ib, ih, i: (ib, ih, 0))
-    kv_blk_spec = pl.BlockSpec((1, 1, block_k, d),
-                               lambda ib, ih, i: (ib, ih, i, 0))
+    semantics = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel",
+                             "arbitrary")) if not interpret else None
 
+    # dK/dV: grid over kv blocks, q blocks innermost (accumulated).
+    q_by_inner = pl.BlockSpec((1, 1, block_q, d),
+                              lambda ib, ih, ik, iq: (ib, ih, iq, 0))
+    row_by_inner = pl.BlockSpec((1, 1, block_q, _STAT_LANES),
+                                lambda ib, ih, ik, iq: (ib, ih, iq, 0))
+    kv_by_outer = pl.BlockSpec((1, 1, block_k, d),
+                               lambda ib, ih, ik, iq: (ib, ih, ik, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkdv_kernel, block_q=block_q, **common),
-        grid=(b, h, sk_p // block_k),
-        in_specs=[q_spec, kv_blk_spec, kv_blk_spec, q_spec, row_spec,
-                  row_spec],
-        out_specs=[kv_blk_spec, kv_blk_spec],
+        functools.partial(_flash_bwd_dkdv_kernel, **common),
+        grid=(b, h, sk_p // block_k, sq_p // block_q),
+        in_specs=[q_by_inner, kv_by_outer, kv_by_outer, q_by_inner,
+                  row_by_inner, row_by_inner],
+        out_specs=[kv_by_outer, kv_by_outer],
         out_shape=[jax.ShapeDtypeStruct((b, h, sk_p, d), k.dtype),
                    jax.ShapeDtypeStruct((b, h, sk_p, d), v.dtype)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ) if not interpret else None,
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=semantics,
         interpret=interpret,
     )(qp, kp, vp, dop, lse_p, delta_p)
 
-    q_blk_spec = pl.BlockSpec((1, 1, block_q, d),
-                              lambda ib, ih, i: (ib, ih, i, 0))
-    row_blk_spec = pl.BlockSpec((1, 1, block_q),
-                                lambda ib, ih, i: (ib, ih, i))
-    kv_spec = pl.BlockSpec((1, 1, sk_p, d), lambda ib, ih, i: (ib, ih, 0, 0))
-
+    # dQ: grid over q blocks, kv blocks innermost (accumulated).
+    q_by_outer = pl.BlockSpec((1, 1, block_q, d),
+                              lambda ib, ih, iq, ik: (ib, ih, iq, 0))
+    row_by_outer = pl.BlockSpec((1, 1, block_q, _STAT_LANES),
+                                lambda ib, ih, iq, ik: (ib, ih, iq, 0))
+    kv_by_inner = pl.BlockSpec((1, 1, block_k, d),
+                               lambda ib, ih, iq, ik: (ib, ih, ik, 0))
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, block_k=block_k, **common),
-        grid=(b, h, sq_p // block_q),
-        in_specs=[q_blk_spec, kv_spec, kv_spec, q_blk_spec, row_blk_spec,
-                  row_blk_spec],
-        out_specs=q_blk_spec,
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(b, h, sq_p // block_q, sk_p // block_k),
+        in_specs=[q_by_outer, kv_by_inner, kv_by_inner, q_by_outer,
+                  row_by_outer, row_by_outer],
+        out_specs=q_by_outer,
         out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ) if not interpret else None,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=semantics,
         interpret=interpret,
     )(qp, kp, vp, dop, lse_p, delta_p)
 
