@@ -211,6 +211,14 @@ def _train_on_stack(args, cfg: ExperimentConfig) -> int:
 
 
 def _cmd_bench(args) -> int:
+    if getattr(args, "ops", None):
+        from ..opsbench import main as opsbench_main
+
+        ops_argv = ["--suite", args.ops, "--steps", str(args.steps)]
+        if args.global_batch:
+            ops_argv += ["--batch", str(args.global_batch)]
+        opsbench_main(ops_argv)
+        return 0
     if args.collectives:
         # The nccl-tests role: psum/all-gather/ppermute/reduce-scatter bus
         # bandwidth over the mesh's links, one JSON line per op.
@@ -350,6 +358,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "instead of a training-step bench")
     be.add_argument("--size-mb", type=float, default=64.0,
                     help="collectives payload size in MB")
+    be.add_argument("--ops", choices=["detection", "resnet", "all"],
+                    help="run the op-level microbench suite (opsbench) "
+                         "instead of a training-step bench")
     be.set_defaults(fn=_cmd_bench)
 
     # data -------------------------------------------------------------------

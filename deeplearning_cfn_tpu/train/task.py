@@ -142,6 +142,14 @@ class ClassificationTask:
         if train:
             aux["batch_stats"] = new_stats
         else:
+            # Top-5, the ImageNet-era companion metric (the reference's
+            # example scripts printed both). top_k would sort; a rank
+            # comparison is one reduction, no sort.
+            label_logit = jnp.take_along_axis(
+                logits, batch["label"][:, None], axis=-1)
+            rank = jnp.sum((logits > label_logit).astype(jnp.int32), -1)
+            top5 = (rank < 5).astype(jnp.float32)
+            aux["accuracy_top5"] = jnp.sum(top5 * mask) / denom
             aux["eval_weight"] = jnp.sum(mask)
         return loss, aux
 

@@ -36,6 +36,34 @@ def test_resnet50_shapes_and_params():
     assert logits.dtype == jnp.float32  # head forced to f32
 
 
+def test_space_to_depth_exact():
+    from deeplearning_cfn_tpu.models.resnet import space_to_depth
+
+    x = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 8, 3)
+    y = space_to_depth(x, 2)
+    assert y.shape == (2, 4, 4, 12)
+    # Block (i,j) of the output must hold the 2×2 input block row-major:
+    # channels [0:3]=(2i,2j), [3:6]=(2i,2j+1), [6:9]=(2i+1,2j), [9:12]=(2i+1,2j+1).
+    np.testing.assert_array_equal(y[0, 1, 2, 0:3], x[0, 2, 4, :])
+    np.testing.assert_array_equal(y[0, 1, 2, 3:6], x[0, 2, 5, :])
+    np.testing.assert_array_equal(y[0, 1, 2, 6:9], x[0, 3, 4, :])
+    np.testing.assert_array_equal(y[0, 1, 2, 9:12], x[0, 3, 5, :])
+
+
+def test_resnet50_s2d_stem():
+    # The s2d variant must produce the same output shape as the classic
+    # stem (downstream stages are identical) with a 4×4×12 stem kernel.
+    model = build_model("resnet50_s2d", num_classes=1000, dtype=jnp.bfloat16)
+    x = jnp.zeros((1, 64, 64, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    stem_kernel = variables["params"]["conv_init_s2d"]["kernel"]
+    assert stem_kernel.shape == (4, 4, 12, 64), stem_kernel.shape
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (1, 1000)
+    n = _param_count(variables["params"])
+    assert 24e6 < n < 27e6, n  # same ballpark as classic resnet50
+
+
 def test_batchnorm_stats_update():
     model = build_model("resnet20", num_classes=10, dtype=jnp.float32)
     x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))

@@ -115,7 +115,9 @@ def test_eval_uses_global_batch(tmp_workdir, devices):
     eval_pipe = build_pipeline(cfg.data, cfg.train.global_batch, 10,
                                train=False)
     metrics = trainer.evaluate(state, eval_pipe.one_epoch(), max_steps=2)
-    assert set(metrics) >= {"loss", "accuracy"}
+    assert set(metrics) >= {"loss", "accuracy", "accuracy_top5"}
+    # Top-5 can never be beaten by top-1 and both are proportions.
+    assert 0.0 <= metrics["accuracy"] <= metrics["accuracy_top5"] <= 1.0
 
 
 def test_gradients_identical_across_mesh_layouts(tmp_workdir, devices):
