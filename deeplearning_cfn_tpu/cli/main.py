@@ -232,6 +232,27 @@ def _cmd_bench(args) -> int:
         return 0
     from ..bench import run_bench
 
+    if getattr(args, "sweep_batches", None):
+        # Batch-size tuning table (how BASELINE.md's 512-vs-1024 row was
+        # found): one JSON line per global batch, same process so later
+        # sizes reuse the warm backend.
+        try:
+            batches = [int(b) for b in args.sweep_batches.split(",") if b]
+        except ValueError:
+            print(f"[dlcfn-tpu] bad --sweep-batches {args.sweep_batches!r}: "
+                  "expected comma-separated integers, e.g. 256,512,768",
+                  file=sys.stderr)
+            return 2
+        if not batches or any(b <= 0 for b in batches):
+            print("[dlcfn-tpu] --sweep-batches values must be positive "
+                  "integers", file=sys.stderr)
+            return 2
+        for gb in batches:
+            line = run_bench(preset=args.preset, steps=args.steps,
+                             global_batch=gb,
+                             include_input=args.with_input)
+            print(json.dumps(line), flush=True)
+        return 0
     line = run_bench(preset=args.preset, steps=args.steps,
                      global_batch=args.global_batch,
                      include_input=args.with_input)
@@ -361,6 +382,9 @@ def build_parser() -> argparse.ArgumentParser:
     be.add_argument("--ops", choices=["detection", "resnet", "all"],
                     help="run the op-level microbench suite (opsbench) "
                          "instead of a training-step bench")
+    be.add_argument("--sweep-batches",
+                    help="comma-separated global batch sizes to bench in "
+                         "sequence (one JSON line each), e.g. 256,512,768")
     be.set_defaults(fn=_cmd_bench)
 
     # data -------------------------------------------------------------------

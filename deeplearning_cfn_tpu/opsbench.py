@@ -59,17 +59,24 @@ def _emit(name: str, ms: float, **extra) -> None:
     print(json.dumps({"op": name, "ms": round(ms, 2), **extra}), flush=True)
 
 
-def suite_resnet(batch: int = 512, steps: int = 10) -> Dict[str, float]:
-    """Classic 7×7 stem vs space-to-depth stem, full fwd+bwd at the
-    imagenet_resnet50 bench shape. The s2d stem exists because the 7×7/s2
-    conv has 3 input channels — ~2% MXU lane packing (models/resnet.py)."""
+def suite_resnet(batch: int = 512, steps: int = 10, image_size: int = 224
+                 ) -> Dict[str, float]:
+    """Classic 7×7 stem vs space-to-depth stem, full fwd+bwd. Defaults to
+    the imagenet_resnet50 bench shape (224²); ``image_size`` shrinks it for
+    CPU smoke runs — stem-comparison numbers are only meaningful at 224.
+    The s2d stem exists because the 7×7/s2 conv has 3 input channels —
+    ~2% MXU lane packing (models/resnet.py)."""
     import jax
     import jax.numpy as jnp
 
     from .models import build_model
 
+    if image_size % 2:
+        raise ValueError(
+            f"image_size must be even (s2d folds 2x2 blocks), got "
+            f"{image_size}")
     results = {}
-    x = jnp.zeros((batch, 224, 224, 3), jnp.bfloat16)
+    x = jnp.zeros((batch, image_size, image_size, 3), jnp.bfloat16)
     y = jnp.zeros((batch,), jnp.int32)
     for name in ("resnet50", "resnet50_s2d"):
         model = build_model(name, num_classes=1000, dtype=jnp.bfloat16)
@@ -266,10 +273,13 @@ def main(argv=None) -> None:
     parser.add_argument("--steps", type=int, default=5)
     parser.add_argument("--batch", type=int, default=0)
     parser.add_argument("--image-size", type=int, default=0,
-                        help="override detection image size (CPU smoke)")
+                        help="override the input image size for BOTH suites "
+                             "(CPU smoke; chip numbers should use the "
+                             "defaults: resnet 224, detection 1024)")
     args = parser.parse_args(argv)
     if args.suite in ("resnet", "all"):
-        suite_resnet(batch=args.batch or 512, steps=args.steps)
+        suite_resnet(batch=args.batch or 512, steps=args.steps,
+                     image_size=args.image_size or 224)
     if args.suite in ("detection", "all"):
         suite_detection(batch=args.batch or 4, steps=args.steps,
                         image_size=args.image_size)

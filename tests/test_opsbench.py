@@ -1,0 +1,58 @@
+"""Op-level microbench harness: sync contract + suite smoke.
+
+The suites' real purpose is chip diagnosis (the 0.05-MFU detection-step
+breakdown); these tests pin the harness mechanics so the module stays
+exercised — timing sanity on CPU, not performance claims.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning_cfn_tpu.opsbench import suite_resnet, timed_scalar
+
+
+def test_timed_scalar_measures_work():
+    # A jitted matmul chain: timing must be positive and scale roughly
+    # with the step count's work (not collapse to dispatch-only time).
+    x = jnp.ones((128, 128))
+
+    @jax.jit
+    def f(x, tok):
+        y = x + tok
+        for _ in range(4):
+            y = y @ x
+        return jnp.sum(y * 1e-9)
+
+    ms = timed_scalar(f, x, steps=3, warmup=1)
+    assert ms > 0.0
+
+
+def test_timed_scalar_orders_by_cost():
+    # The timing must reflect actual device work: a 50-matmul chain over
+    # 512² must measure slower than a single 64² matmul. Contrast is ~3
+    # orders of magnitude, so this is robust to scheduler noise.
+    small = jnp.ones((64, 64))
+    big = jnp.ones((512, 512))
+
+    @jax.jit
+    def f_small(x, tok):
+        return jnp.sum((x + tok) @ x) * 1e-9
+
+    @jax.jit
+    def f_big(x, tok):
+        y = x + tok
+        for _ in range(50):
+            y = y @ x * 1e-3
+        return jnp.sum(y) * 1e-9
+
+    ms_small = timed_scalar(f_small, small, steps=3, warmup=1)
+    ms_big = timed_scalar(f_big, big, steps=3, warmup=1)
+    assert ms_big > ms_small
+
+
+def test_suite_resnet_smoke():
+    # Tiny shapes: both stem variants build, run fwd+bwd, and report
+    # positive times. (CPU; the A/B question itself is a TPU matter.)
+    results = suite_resnet(batch=2, steps=1, image_size=64)
+    assert set(results) == {"resnet50", "resnet50_s2d"}
+    assert all(v > 0 for v in results.values())
