@@ -211,6 +211,16 @@ def _train_on_stack(args, cfg: ExperimentConfig) -> int:
 
 
 def _cmd_bench(args) -> int:
+    if getattr(args, "sweep_batches", None):
+        if getattr(args, "ops", None) or args.collectives:
+            print("[dlcfn-tpu] --sweep-batches only applies to the "
+                  "training-step bench (not --ops/--collectives)",
+                  file=sys.stderr)
+            return 2
+        if args.global_batch:
+            print("[dlcfn-tpu] pass either --sweep-batches or "
+                  "--global-batch, not both", file=sys.stderr)
+            return 2
     if getattr(args, "ops", None):
         from ..opsbench import main as opsbench_main
 

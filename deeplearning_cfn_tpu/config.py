@@ -107,6 +107,16 @@ class TrainConfig:
     shard_opt_state: bool = False
     label_smoothing: float = 0.0
     ema_decay: float = 0.0  # 0 = off
+    # Gradient accumulation: split each global batch into this many
+    # microbatches, lax.scan over them accumulating grads, apply the
+    # optimizer once. Reproduces the reference recipes' pod-scale global
+    # batches (LARS@32k, LAMB@64k) on few chips, and caps activation
+    # memory for long-sequence models. Semantics match the Horovod path:
+    # the step loss/grad is the mean of per-microbatch means (identical to
+    # the full-batch mean for unweighted losses; for weighted losses —
+    # MLM, NMT padding — it reweights exactly like per-GPU averaging did).
+    # BatchNorm sees microbatch statistics sequentially.
+    grad_accum_steps: int = 1
 
 
 @dataclasses.dataclass

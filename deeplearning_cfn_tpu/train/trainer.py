@@ -93,6 +93,14 @@ class Trainer:
         self.tx = tx
         self.mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
         validate_batch(cfg.train.global_batch, self.mesh)
+        accum = cfg.train.grad_accum_steps
+        if accum > 1 and cfg.train.global_batch % accum != 0:
+            raise ValueError(
+                f"global batch {cfg.train.global_batch} must be divisible "
+                f"by grad_accum_steps ({accum})")
+        if accum > 1:
+            # Each microbatch must still split over the data ways.
+            validate_batch(cfg.train.global_batch // accum, self.mesh)
         self.spatial_dim = spatial_dim
         # Which batch keys the spatial shard applies to (None = any array
         # with >=4 dims). Detection restricts it to "image" — its mask
@@ -140,22 +148,83 @@ class Trainer:
         tx = self.tx
         loss_fn = self.loss_fn
         ema_decay = self.cfg.train.ema_decay
+        accum = self.cfg.train.grad_accum_steps
 
-        def train_step(state: TrainState, batch: Batch, rng: jax.Array):
-            step_rng = jax.random.fold_in(rng, state.step)
-
+        def grads_and_metrics(state, batch, step_rng):
             def compute(params):
-                loss, aux = loss_fn(params, state.batch_stats, batch,
-                                    step_rng, True)
-                return loss, aux
+                return loss_fn(params, state.batch_stats, batch,
+                               step_rng, True)
 
             (loss, aux), grads = jax.value_and_grad(compute, has_aux=True)(
                 state.params
             )
             new_stats = aux.pop("batch_stats", state.batch_stats)
+            return grads, new_stats, {"loss": loss, **aux}
+
+        def accum_grads_and_metrics(state, batch, step_rng):
+            # Microbatch split is STRIDED along the batch dim (row i goes
+            # to microbatch i % accum): per device this is a local
+            # reshape+transpose of its contiguous shard — no cross-device
+            # resharding — and batch rows are i.i.d., so the partition
+            # choice is semantically free.
+            def split(v):
+                g = v.shape[0]
+                return v.reshape(g // accum, accum, *v.shape[1:]) \
+                        .swapaxes(0, 1)
+
+            micro = jax.tree_util.tree_map(split, batch)
+
+            def body(carry, xs):
+                g_acc, stats, m_acc = carry
+                i, mb = xs
+                # Distinct dropout noise per microbatch.
+                mb_rng = jax.random.fold_in(step_rng, i)
+
+                def compute(params):
+                    return loss_fn(params, stats, mb, mb_rng, True)
+
+                (loss, aux), grads = jax.value_and_grad(
+                    compute, has_aux=True)(state.params)
+                new_stats = aux.pop("batch_stats", stats)
+                metrics = {"loss": loss, **aux}
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
+                m_acc = {k: m_acc[k] + v for k, v in metrics.items()}
+                return (g_acc, new_stats, m_acc), None
+
+            g0 = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            # Probe the metric dict's structure abstractly to build the
+            # scan carry's accumulator — a forward-only eval_shape of
+            # loss_fn (tracing the backward too would double the abstract
+            # trace cost just to read dict keys).
+            _, aux_probe = jax.eval_shape(
+                lambda p: loss_fn(
+                    p, state.batch_stats,
+                    jax.tree_util.tree_map(lambda v: v[0], micro),
+                    step_rng, True),
+                state.params)
+            aux_probe = dict(aux_probe)
+            aux_probe.pop("batch_stats", None)
+            m0 = {"loss": jnp.zeros((), jnp.float32),
+                  **{k: jnp.zeros(v.shape, jnp.float32)
+                     for k, v in aux_probe.items()}}
+            (g_sum, new_stats, m_sum), _ = jax.lax.scan(
+                body, (g0, state.batch_stats, m0),
+                (jnp.arange(accum), micro))
+            inv = 1.0 / accum
+            grads = jax.tree_util.tree_map(lambda g: g * inv, g_sum)
+            metrics = {k: v * inv for k, v in m_sum.items()}
+            return grads, new_stats, metrics
+
+        def train_step(state: TrainState, batch: Batch, rng: jax.Array):
+            step_rng = jax.random.fold_in(rng, state.step)
+            if accum > 1:
+                grads, new_stats, metrics = accum_grads_and_metrics(
+                    state, batch, step_rng)
+            else:
+                grads, new_stats, metrics = grads_and_metrics(
+                    state, batch, step_rng)
             new_state = state.apply_gradients(grads, tx, ema_decay)
             new_state = new_state.replace(batch_stats=new_stats)
-            metrics = {"loss": loss, **aux}
             # Same implementation clip_by_global_norm uses, so the logged
             # norm matches the clipping decision.
             metrics["grad_norm"] = optax.global_norm(grads)

@@ -323,3 +323,65 @@ def test_exact_eval_counts_every_example(tmp_workdir, devices):
         correct += int((pred[m] == batch["label"][m]).sum())
     np.testing.assert_allclose(metrics["accuracy"], correct / 70.0,
                                atol=1e-6)
+
+
+def test_grad_accum_matches_full_batch(devices):
+    """grad_accum_steps=k must give exactly the full-batch update for an
+    unweighted mean loss with no BN: mean of k equal-size microbatch
+    gradients == the global-batch gradient, and the optimizer runs once."""
+    from deeplearning_cfn_tpu.config import MeshConfig
+    import optax
+
+    cfg = _tiny_cfg("/tmp/unused")
+    cfg.train.global_batch = 32
+
+    def init_fn(rng):
+        return {"params": {"w": jnp.zeros((8,), jnp.float32)}}
+
+    def loss_fn(params, batch_stats, batch, rng, train):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2), {}
+
+    mesh = build_mesh(MeshConfig(data=-1))
+    tx = optax.sgd(0.1)
+    x = np.random.RandomState(0).randn(32, 8).astype(np.float32)
+    y = np.random.RandomState(1).randn(32).astype(np.float32)
+    rng = jax.random.PRNGKey(0)
+
+    results = {}
+    for accum in (1, 4):
+        cfg.train.grad_accum_steps = accum
+        state = create_train_state(jax.random.PRNGKey(0), init_fn, tx, mesh)
+        trainer = Trainer(cfg, loss_fn, tx, mesh=mesh)
+        batch = trainer.device_batch({"x": x, "y": y})
+        new_state, metrics = trainer.train_step(state, batch, rng)
+        results[accum] = (np.asarray(new_state.params["w"]),
+                          float(metrics["loss"]),
+                          float(metrics["grad_norm"]))
+
+    w1, l1, g1 = results[1]
+    w4, l4, g4 = results[4]
+    # f32 summation order differs (mean-of-4-means vs one mean): allow
+    # a few ulps, nothing more.
+    np.testing.assert_allclose(w4, w1, rtol=1e-5, atol=1e-8)
+    np.testing.assert_allclose(l4, l1, rtol=1e-5)
+    np.testing.assert_allclose(g4, g1, rtol=1e-5)
+
+
+def test_grad_accum_trains_bn_model(tmp_workdir, devices):
+    """The accumulation path must also run the full preset machinery
+    (BN stats threaded through the scan carry, metrics averaged)."""
+    cfg = _tiny_cfg(tmp_workdir, steps=4)
+    apply_overrides(cfg, ["train.grad_accum_steps=2"])
+    metrics = run_experiment(cfg)
+    assert np.isfinite(metrics["loss"])
+
+
+def test_grad_accum_divisibility_validated(devices):
+    cfg = _tiny_cfg("/tmp/unused")
+    cfg.train.global_batch = 32  # divisible by the 8 data ways, not by 3
+    cfg.train.grad_accum_steps = 3
+    mesh = build_mesh(cfg.mesh)
+
+    with pytest.raises(ValueError, match="grad_accum_steps"):
+        Trainer(cfg, lambda *a: None, None, mesh=mesh)
