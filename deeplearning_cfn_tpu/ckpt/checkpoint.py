@@ -337,7 +337,39 @@ class CheckpointManager:
                         async_write=self.async_write,
                         _thread_holder=self._threads)
 
-    def restore_or_none(self, target: PyTree, shardings=None):
+    def restore_or_none(self, target: PyTree, shardings=None,
+                        step: int = 0):
+        """Restore the latest committed checkpoint, or an explicit ``step``
+        (>0) — the manual-rollback contract (resume from before a bad LR
+        change or a corrupted tail). An explicit step that does not exist
+        as a committed checkpoint is an error, not a silent fallback.
+
+        Rolling back DELETES every checkpoint directory past the restore
+        point (committed or not, rank 0 only): they are no longer on the
+        training timeline, a later auto-resume must not pick them up, and
+        re-saving those steps must start from an empty directory — writing
+        into a dir that still holds another run's shard/manifest/marker
+        files would break the two-phase commit's atomicity (a stale
+        higher-numbered ``manifest_p*`` would even merge stale arrays into
+        a future restore)."""
+        if step > 0:
+            committed = _committed_steps(self.store)
+            if step not in committed:
+                raise FileNotFoundError(
+                    f"no committed checkpoint at step {step} in "
+                    f"{self.directory}; available: {committed}")
+            result = restore_checkpoint(self.store, target, step, shardings)
+            if jax.process_index() == 0:
+                for name in self.store.list_subdirs(""):
+                    if not name.startswith("step_"):
+                        continue
+                    try:
+                        s = int(name[len("step_"):])
+                    except ValueError:
+                        continue
+                    if s > step:
+                        self.store.delete_prefix(f"{name}/")
+            return result
         step = latest_checkpoint(self.store)
         if step is None:
             return None, None

@@ -270,6 +270,80 @@ def _cmd_bench(args) -> int:
     return 0
 
 
+def _cmd_doctor(args) -> int:
+    """Preflight: the reference-era 'verify drivers / EFA provider' role.
+    Every check prints one line with a wall-clock timestamp so a hang is
+    attributable to an exact stage (this image's TPU plugin is known to
+    hang in backend init — see bench.py)."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    ok = True
+
+    def report(name, good, detail=""):
+        nonlocal ok
+        ok &= bool(good)
+        mark = "ok" if good else "FAIL"
+        print(f"[doctor t=+{_time.monotonic() - t0:5.1f}s] "
+              f"{name}: {mark}{' — ' + detail if detail else ''}",
+              flush=True)
+
+    # 1. Package + presets resolve.
+    try:
+        from ..presets import get_preset, list_presets
+
+        names = list_presets()
+        for name in names:
+            get_preset(name)
+        report("presets", True, f"{len(names)} presets resolve")
+    except Exception as e:
+        report("presets", False, repr(e))
+
+    # 2. Native data loader builds (or degrades cleanly).
+    try:
+        from .. import dataio
+
+        if dataio.available():
+            report("native-loader", True, "dataio.so built and loadable")
+        else:
+            report("native-loader", True,
+                   "unavailable; Python fallback active (no g++?)")
+    except Exception as e:
+        report("native-loader", False, repr(e))
+
+    # 3. Accelerator backend: import → init → devices, stage by stage.
+    if args.skip_backend:
+        report("backend", True, "skipped on request")
+    else:
+        try:
+            from ..runtime.platform import honor_env_platform
+
+            honor_env_platform()
+            import jax
+
+            report("jax-import", True, f"jax {jax.__version__}")
+            devices = jax.devices()  # the stage that hangs on bad images
+            kinds = sorted({getattr(d, "device_kind", "?")
+                            for d in devices})
+            report("backend-init", True,
+                   f"{len(devices)} device(s): {', '.join(kinds)}")
+            import jax.numpy as jnp
+
+            x = jnp.ones((128, 128))
+            val = float((x @ x).sum())  # executes + syncs one real program
+            report("device-exec", val == 128.0 * 128 * 128,
+                   f"matmul sum={val:.0f}")
+            from ..config import MeshConfig
+            from ..parallel.mesh import build_mesh, describe
+
+            report("mesh", True, describe(build_mesh(MeshConfig(data=-1))))
+        except Exception as e:
+            report("backend", False, repr(e))
+
+    print(f"[doctor] {'all checks passed' if ok else 'CHECKS FAILED'}")
+    return 0 if ok else 1
+
+
 def _cmd_data_prepare_imagenet(args) -> int:
     from ..data.imagenet import prepare_imagenet
 
@@ -376,6 +450,15 @@ def build_parser() -> argparse.ArgumentParser:
 
     inf = sub.add_parser("info", help="device / mesh info")
     inf.set_defaults(fn=_cmd_info)
+
+    doc = sub.add_parser(
+        "doctor",
+        help="preflight checks: backend init (stage-timestamped), native "
+             "loader build, preset integrity")
+    doc.add_argument("--skip-backend", action="store_true",
+                     help="skip accelerator init (for hosts where the "
+                          "backend is known-hung)")
+    doc.set_defaults(fn=_cmd_doctor)
 
     be = sub.add_parser("bench", help="run the benchmark harness")
     be.add_argument("--preset", default="cifar10_resnet20")

@@ -106,6 +106,28 @@ def test_manager_async_and_resume(tmp_workdir):
     assert none_mgr.restore_or_none(state) == (None, None)
 
 
+def test_manager_restore_explicit_step(tmp_workdir):
+    """restore_or_none(step=N) is the manual-rollback contract: an exact
+    committed step restores; a missing step errors instead of silently
+    falling back to latest."""
+    mgr = CheckpointManager(tmp_workdir, every_steps=2, keep=3,
+                            async_write=False)
+    for step in [2, 4, 6]:
+        mgr.save(step, {"w": jnp.full((4,), float(step))})
+    target = {"w": jnp.zeros((4,))}
+    restored, step = mgr.restore_or_none(target, step=4)
+    assert step == 4
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.full((4,), 4.0))
+    # Rollback removed everything past the restore point: the abandoned
+    # step-6 checkpoint must not resurface on a later latest-restore, and
+    # its directory must be gone (re-saving step 6 starts clean).
+    assert latest_checkpoint(tmp_workdir) == 4
+    assert not os.path.exists(os.path.join(tmp_workdir, "step_00000006"))
+    with pytest.raises(FileNotFoundError, match="available"):
+        mgr.restore_or_none(target, step=3)
+
+
 def test_missing_leaf_raises(tmp_workdir):
     save_checkpoint(tmp_workdir, 1, {"a": jnp.ones(3)})
     with pytest.raises(KeyError):

@@ -27,6 +27,21 @@ def test_config_shows_resolved_preset_with_overrides(capsys):
     assert cfg["train"]["global_batch"] == 64
 
 
+def test_doctor_passes_on_cpu(capsys, devices):
+    assert main(["doctor"]) == 0
+    out = capsys.readouterr().out
+    for check in ["presets: ok", "native-loader: ok", "backend-init: ok",
+                  "device-exec: ok", "mesh: ok", "all checks passed"]:
+        assert check in out, out
+
+
+def test_doctor_skip_backend(capsys):
+    assert main(["doctor", "--skip-backend"]) == 0
+    out = capsys.readouterr().out
+    assert "backend: ok — skipped on request" in out
+    assert "device-exec" not in out
+
+
 def test_config_rejects_unknown_override():
     with pytest.raises(KeyError):
         main(["config", "--preset", "cifar10_resnet20", "train.nope=1"])
