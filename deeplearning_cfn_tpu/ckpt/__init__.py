@@ -2,6 +2,7 @@ from .checkpoint import (  # noqa: F401
     CheckpointManager,
     latest_checkpoint,
     restore_checkpoint,
+    rollback_checkpoints,
     save_checkpoint,
 )
 from .store import (  # noqa: F401

@@ -174,6 +174,42 @@ def _garbage_collect(store: Store, keep: int):
         store.delete_prefix(f"{_step_key(step)}/")
 
 
+def rollback_checkpoints(directory: StoreOrPath, step: int) -> List[int]:
+    """Roll the checkpoint timeline back to ``step``: delete EVERY
+    checkpoint directory past it (committed or not) and return the sorted
+    list of deleted steps. After this, auto-resume restores ``step``.
+
+    Deleting rather than ignoring matters twice over: a later auto-resume
+    must not pick an abandoned checkpoint back up, and re-saving one of
+    those steps must start from an empty directory — writing into a dir
+    that still holds another run's shard/manifest/marker files would break
+    the two-phase commit's atomicity (a stale higher-numbered
+    ``manifest_p*`` would even merge stale arrays into a future restore).
+
+    One-shot and imperative (the ``ckpt rollback`` CLI verb), never driven
+    from training config: a persisted rollback setting would re-run on
+    every restart and silently destroy the progress made since.
+    """
+    store = open_store(directory)
+    committed = _committed_steps(store)
+    if step not in committed:
+        raise FileNotFoundError(
+            f"no committed checkpoint at step {step}; available: "
+            f"{sorted(committed)}")
+    deleted = []
+    for name in store.list_subdirs(""):
+        if not name.startswith("step_"):
+            continue
+        try:
+            s = int(name[len("step_"):])
+        except ValueError:
+            continue
+        if s > step:
+            store.delete_prefix(f"{name}/")
+            deleted.append(s)
+    return sorted(deleted)
+
+
 # -- restore ----------------------------------------------------------------
 
 
@@ -340,39 +376,22 @@ class CheckpointManager:
     def restore_or_none(self, target: PyTree, shardings=None,
                         step: int = 0):
         """Restore the latest committed checkpoint, or an explicit ``step``
-        (>0) — the manual-rollback contract (resume from before a bad LR
-        change or a corrupted tail). An explicit step that does not exist
-        as a committed checkpoint is an error, not a silent fallback.
-
-        Rolling back DELETES every checkpoint directory past the restore
-        point (committed or not, rank 0 only): they are no longer on the
-        training timeline, a later auto-resume must not pick them up, and
-        re-saving those steps must start from an empty directory — writing
-        into a dir that still holds another run's shard/manifest/marker
-        files would break the two-phase commit's atomicity (a stale
-        higher-numbered ``manifest_p*`` would even merge stale arrays into
-        a future restore)."""
+        (>0). Read-only: an explicit step that is not committed is an
+        error, not a silent fallback. To roll the training timeline back
+        (delete everything past a step), use :func:`rollback_checkpoints`
+        — an imperative, one-shot operation, deliberately NOT a config
+        knob (a persisted rollback setting would re-delete the new
+        progress on every relaunch)."""
         if step > 0:
             committed = _committed_steps(self.store)
             if step not in committed:
                 raise FileNotFoundError(
                     f"no committed checkpoint at step {step} in "
-                    f"{self.directory}; available: {committed}")
-            result = restore_checkpoint(self.store, target, step, shardings)
-            if jax.process_index() == 0:
-                for name in self.store.list_subdirs(""):
-                    if not name.startswith("step_"):
-                        continue
-                    try:
-                        s = int(name[len("step_"):])
-                    except ValueError:
-                        continue
-                    if s > step:
-                        self.store.delete_prefix(f"{name}/")
-            return result
-        step = latest_checkpoint(self.store)
-        if step is None:
-            return None, None
+                    f"{self.directory}; available: {sorted(committed)}")
+        else:
+            step = latest_checkpoint(self.store)
+            if step is None:
+                return None, None
         return restore_checkpoint(self.store, target, step, shardings)
 
     def wait(self):

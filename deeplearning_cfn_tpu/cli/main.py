@@ -63,6 +63,22 @@ def _cmd_stack_create(args) -> int:
     return 0
 
 
+def _cmd_stack_resize(args) -> int:
+    from ..provision import ProvisionError, StackStore, resize_stack
+
+    try:
+        state = resize_stack(args.name, args.slice_type,
+                             store=StackStore(args.state_dir))
+    except (KeyError, ProvisionError) as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    print(f"[dlcfn-tpu] stack {state.name!r} resized to "
+          f"{state.slice_type}: {len(state.hosts)} hosts ready; relaunch "
+          f"`train --stack {state.name}` to resume from the last "
+          f"checkpoint")
+    return 0
+
+
 def _cmd_stack_delete(args) -> int:
     from ..provision import ProvisionError, StackStore, delete_stack
 
@@ -344,6 +360,28 @@ def _cmd_doctor(args) -> int:
     return 0 if ok else 1
 
 
+def _cmd_ckpt_list(args) -> int:
+    from ..ckpt.checkpoint import _committed_steps
+
+    steps = sorted(_committed_steps(args.dir))
+    print(json.dumps({"directory": args.dir, "committed_steps": steps}))
+    return 0
+
+
+def _cmd_ckpt_rollback(args) -> int:
+    from ..ckpt import rollback_checkpoints
+
+    try:
+        deleted = rollback_checkpoints(args.dir, args.step)
+    except FileNotFoundError as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    print(f"[dlcfn-tpu] rolled back to step {args.step}; deleted "
+          f"{len(deleted)} later checkpoint(s): {deleted}. The next "
+          f"training launch will auto-resume from step {args.step}.")
+    return 0
+
+
 def _cmd_data_prepare_imagenet(args) -> int:
     from ..data.imagenet import prepare_imagenet
 
@@ -412,6 +450,16 @@ def build_parser() -> argparse.ArgumentParser:
     _add_stack_args(sc)
     sc.set_defaults(fn=_cmd_stack_create)
 
+    sr = ssub.add_parser(
+        "resize",
+        help="scale a stack to a new slice type (delete + recreate; "
+             "training resumes from the last checkpoint on relaunch)")
+    sr.add_argument("name")
+    sr.add_argument("--slice", required=True, dest="slice_type",
+                    help="new slice type, e.g. v5p-16")
+    _add_stack_args(sr)
+    sr.set_defaults(fn=_cmd_stack_resize)
+
     sd = ssub.add_parser("delete", help="delete a stack")
     sd.add_argument("name")
     _add_stack_args(sd)
@@ -479,6 +527,23 @@ def build_parser() -> argparse.ArgumentParser:
                     help="comma-separated global batch sizes to bench in "
                          "sequence (one JSON line each), e.g. 256,512,768")
     be.set_defaults(fn=_cmd_bench)
+
+    # ckpt -------------------------------------------------------------------
+    ck = sub.add_parser("ckpt", help="checkpoint inspection / rollback")
+    cksub = ck.add_subparsers(dest="ckpt_cmd", required=True)
+
+    ckl = cksub.add_parser("list", help="list committed checkpoint steps")
+    ckl.add_argument("dir", help="checkpoint directory (or gs:// url)")
+    ckl.set_defaults(fn=_cmd_ckpt_list)
+
+    ckr = cksub.add_parser(
+        "rollback",
+        help="delete every checkpoint past STEP so the next training "
+             "launch auto-resumes from STEP (one-shot, irreversible)")
+    ckr.add_argument("dir", help="checkpoint directory (or gs:// url)")
+    ckr.add_argument("--step", type=int, required=True,
+                     help="committed step to roll back to")
+    ckr.set_defaults(fn=_cmd_ckpt_rollback)
 
     # data -------------------------------------------------------------------
     data = sub.add_parser("data", help="dataset preparation / diagnostics")

@@ -171,9 +171,9 @@ class CheckpointConfig:
     keep: int = 3
     async_write: bool = True
     resume: bool = True  # auto-resume from latest on startup
-    # Resume from this exact committed step instead of the latest (manual
-    # rollback); 0 = latest. Errors if the step isn't committed.
-    restore_step: int = 0
+    # NOTE deliberately no restore-step knob here: rolling back is the
+    # imperative `dlcfn-tpu ckpt rollback` verb. A persisted rollback
+    # setting would re-delete new progress on every relaunch.
 
 
 @dataclasses.dataclass

@@ -20,6 +20,7 @@ from .provisioner import (
     create_stack,
     delete_stack,
     get_provisioner,
+    resize_stack,
 )
 from .topology import SliceTopology, slice_topology
 
@@ -36,5 +37,6 @@ __all__ = [
     "create_stack",
     "delete_stack",
     "get_provisioner",
+    "resize_stack",
     "slice_topology",
 ]

@@ -11,6 +11,7 @@ injected failures (the fixture strategy SURVEY.md §5.5 calls for).
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import shutil
@@ -218,6 +219,7 @@ def create_stack(
             f"stack {cfg.name!r} already exists; delete it first"
         )
     state = prov.create(cfg)
+    state.create_config = dataclasses.asdict(cfg)
     store.save(state)
 
     deadline = time.time() + cfg.create_timeout_s
@@ -256,6 +258,51 @@ def create_stack(
     state.status = StackStatus.CREATE_COMPLETE
     store.save(state)
     return state
+
+
+def resize_stack(
+    name: str,
+    new_slice_type: str,
+    store: Optional[StackStore] = None,
+    provisioner: Optional[Provisioner] = None,
+    poll_interval_s: float = 5.0,
+    on_status: Optional[Callable[[StackState], None]] = None,
+    _sleep: Callable[[float], None] = time.sleep,
+) -> StackState:
+    """Scale a stack to a new topology: delete + recreate under the same
+    name (SURVEY §4.5 — the reference resized by updating the ASG's worker
+    count; TPU slices are fixed shapes, so resize is teardown + new slice).
+    Training state survives through checkpoints, not the cluster: relaunch
+    `train --stack <name>` afterwards and the run auto-resumes from the
+    last committed checkpoint, resharded onto the new topology by the
+    cross-topology restore (ckpt/checkpoint.py).
+
+    Every creation knob of the old stack (runtime version, preemptible,
+    timeouts, zone/project/provisioner) carries over from the recorded
+    create-time config; only the slice type changes. If the new slice
+    fails its readiness gate the old stack is already gone — the state
+    record then holds CREATE_FAILED, same as any failed create (no silent
+    half-cluster)."""
+    store = store or StackStore()
+    old = store.load(name)  # KeyError if the stack doesn't exist
+    if old.slice_type == new_slice_type:
+        raise ProvisionError(
+            f"stack {name!r} is already a {new_slice_type}")
+    # Rebuild from the recorded create-time config; fall back to the
+    # mirrored StackState fields for records from before create_config
+    # existed.
+    base = dict(old.create_config) if old.create_config else {
+        "name": name, "slice_type": old.slice_type, "zone": old.zone,
+        "project": old.project, "provisioner": old.provisioner,
+    }
+    base.update(name=name, slice_type=new_slice_type,
+                state_dir=store.state_dir)
+    known = {f.name for f in dataclasses.fields(StackConfig)}
+    cfg = StackConfig(**{k: v for k, v in base.items() if k in known})
+    delete_stack(name, store=store, provisioner=provisioner)
+    return create_stack(cfg, provisioner=provisioner, store=store,
+                        poll_interval_s=poll_interval_s,
+                        on_status=on_status, _sleep=_sleep)
 
 
 def delete_stack(

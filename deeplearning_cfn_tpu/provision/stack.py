@@ -53,6 +53,11 @@ class StackState:
     provisioner: str = "dryrun"
     message: str = ""
     hostfile: str = ""
+    # The full StackConfig this stack was created from (asdict), so
+    # lifecycle operations that recreate the stack (resize) can carry
+    # every knob over — runtime_version, preemptible, timeouts — not just
+    # the fields this record mirrors. Empty for pre-upgrade records.
+    create_config: Dict = dataclasses.field(default_factory=dict)
 
     @property
     def ready(self) -> bool:

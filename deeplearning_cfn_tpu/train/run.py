@@ -72,13 +72,8 @@ def run_experiment(
     manager = CheckpointManager(ckpt_dir, every_steps=ckpt_every,
                                 keep=cfg.checkpoint.keep,
                                 async_write=cfg.checkpoint.async_write)
-    if cfg.checkpoint.restore_step > 0 and not cfg.checkpoint.resume:
-        raise ValueError(
-            "checkpoint.restore_step requires checkpoint.resume=true — "
-            "refusing to silently ignore an explicit rollback request")
     if cfg.checkpoint.resume:
-        restored, at_step = manager.restore_or_none(
-            state, step=cfg.checkpoint.restore_step)
+        restored, at_step = manager.restore_or_none(state)
         if restored is not None:
             state = restored
             if jax.process_index() == 0:

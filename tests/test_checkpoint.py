@@ -107,9 +107,8 @@ def test_manager_async_and_resume(tmp_workdir):
 
 
 def test_manager_restore_explicit_step(tmp_workdir):
-    """restore_or_none(step=N) is the manual-rollback contract: an exact
-    committed step restores; a missing step errors instead of silently
-    falling back to latest."""
+    """restore_or_none(step=N) restores an exact committed step read-only;
+    a missing step errors instead of silently falling back to latest."""
     mgr = CheckpointManager(tmp_workdir, every_steps=2, keep=3,
                             async_write=False)
     for step in [2, 4, 6]:
@@ -119,13 +118,36 @@ def test_manager_restore_explicit_step(tmp_workdir):
     assert step == 4
     np.testing.assert_array_equal(np.asarray(restored["w"]),
                                   np.full((4,), 4.0))
-    # Rollback removed everything past the restore point: the abandoned
-    # step-6 checkpoint must not resurface on a later latest-restore, and
-    # its directory must be gone (re-saving step 6 starts clean).
-    assert latest_checkpoint(tmp_workdir) == 4
-    assert not os.path.exists(os.path.join(tmp_workdir, "step_00000006"))
+    # Read-only: the later checkpoint is untouched.
+    assert latest_checkpoint(tmp_workdir) == 6
     with pytest.raises(FileNotFoundError, match="available"):
         mgr.restore_or_none(target, step=3)
+
+
+def test_rollback_checkpoints(tmp_workdir):
+    """rollback_checkpoints deletes the whole timeline past the target —
+    committed AND uncommitted dirs — so auto-resume picks the rollback
+    point and re-saves start from empty directories."""
+    from deeplearning_cfn_tpu.ckpt import rollback_checkpoints
+
+    mgr = CheckpointManager(tmp_workdir, every_steps=2, keep=5,
+                            async_write=False)
+    for step in [2, 4, 6]:
+        mgr.save(step, {"w": jnp.full((4,), float(step))})
+    # An uncommitted (crashed) step dir past the rollback point must also
+    # go: its stale manifests would poison a future re-save at that step.
+    os.makedirs(os.path.join(tmp_workdir, "step_00000008"))
+    with open(os.path.join(tmp_workdir, "step_00000008", "manifest_p7.json"),
+              "w") as f:
+        f.write("{}")
+
+    deleted = rollback_checkpoints(tmp_workdir, 4)
+    assert deleted == [6, 8]
+    assert latest_checkpoint(tmp_workdir) == 4
+    assert not os.path.exists(os.path.join(tmp_workdir, "step_00000006"))
+    assert not os.path.exists(os.path.join(tmp_workdir, "step_00000008"))
+    with pytest.raises(FileNotFoundError, match="available"):
+        rollback_checkpoints(tmp_workdir, 3)
 
 
 def test_missing_leaf_raises(tmp_workdir):

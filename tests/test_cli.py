@@ -83,6 +83,61 @@ def test_stack_lifecycle(tmp_path, capsys):
                  "--state-dir", state_dir]) == 1
 
 
+def test_stack_resize(tmp_path, capsys):
+    """`stack resize` is the reference's change-the-ASG-worker-count flow:
+    delete + recreate under the same name with the new topology (SURVEY
+    §4.5), training state carried by checkpoints."""
+    state_dir = str(tmp_path)
+    assert main(["stack", "create", "--name", "rz",
+                 "--slice-type", "v5p-8", "--provisioner", "dryrun",
+                 "--state-dir", state_dir]) == 0
+    capsys.readouterr()
+    assert main(["stack", "resize", "rz", "--slice", "v5p-16",
+                 "--state-dir", state_dir]) == 0
+    out = capsys.readouterr().out
+    assert "resized to v5p-16" in out
+
+    assert main(["stack", "status", "rz", "--state-dir", state_dir]) == 0
+    status = json.loads(capsys.readouterr().out)
+    assert status["status"] == "CREATE_COMPLETE"
+    assert status["slice_type"] == "v5p-16"
+    assert len(status["hosts"]) == 4  # v5p-16 = 4 hosts (vs 2 for v5p-8)
+    # Every create-time knob except the slice type carried over into the
+    # recreated stack's recorded config.
+    cc = status["create_config"]
+    assert cc["slice_type"] == "v5p-16"
+    assert cc["provisioner"] == "dryrun"
+    assert cc["runtime_version"] == "tpu-ubuntu2204-base"
+
+    # No-op resize is an error, and the stack survives untouched.
+    assert main(["stack", "resize", "rz", "--slice", "v5p-16",
+                 "--state-dir", state_dir]) == 1
+    assert main(["stack", "resize", "ghost", "--slice", "v5p-16",
+                 "--state-dir", state_dir]) == 1
+    assert main(["stack", "delete", "rz", "--state-dir", state_dir]) == 0
+
+
+def test_ckpt_list_and_rollback_verbs(tmp_path, capsys):
+    import jax.numpy as jnp
+
+    from deeplearning_cfn_tpu.ckpt import save_checkpoint
+
+    d = str(tmp_path)
+    for step in [2, 4, 6]:
+        save_checkpoint(d, step, {"w": jnp.zeros((2,))})
+
+    assert main(["ckpt", "list", d]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["committed_steps"] == [2, 4, 6]
+
+    assert main(["ckpt", "rollback", d, "--step", "4"]) == 0
+    assert "deleted 1 later checkpoint(s): [6]" in capsys.readouterr().out
+    assert main(["ckpt", "list", d]) == 0
+    assert json.loads(capsys.readouterr().out)["committed_steps"] == [2, 4]
+
+    assert main(["ckpt", "rollback", d, "--step", "5"]) == 1
+
+
 def test_stack_status_missing(tmp_path):
     assert main(["stack", "status", "nope",
                  "--state-dir", str(tmp_path)]) == 1
