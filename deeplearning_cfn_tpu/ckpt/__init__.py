@@ -1,5 +1,6 @@
 from .checkpoint import (  # noqa: F401
     CheckpointManager,
+    committed_steps,
     latest_checkpoint,
     restore_checkpoint,
     rollback_checkpoints,

@@ -229,6 +229,18 @@ def latest_checkpoint(directory: StoreOrPath) -> Optional[int]:
     return max(steps) if steps else None
 
 
+def committed_steps(directory: StoreOrPath) -> List[int]:
+    """Sorted committed checkpoint steps — the public inspection surface
+    (the ``ckpt list`` verb). Unlike the internal listing, a nonexistent
+    local directory is an error: "no checkpoints here" and "wrong path"
+    must not look the same to an operator."""
+    if isinstance(directory, str) and not directory.startswith("gs://") \
+            and not os.path.isdir(directory):
+        raise FileNotFoundError(
+            f"no such checkpoint directory: {directory}")
+    return sorted(_committed_steps(directory))
+
+
 def restore_checkpoint(
     directory: StoreOrPath,
     target: PyTree,

@@ -361,9 +361,13 @@ def _cmd_doctor(args) -> int:
 
 
 def _cmd_ckpt_list(args) -> int:
-    from ..ckpt.checkpoint import _committed_steps
+    from ..ckpt import committed_steps
 
-    steps = sorted(_committed_steps(args.dir))
+    try:
+        steps = committed_steps(args.dir)
+    except FileNotFoundError as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
     print(json.dumps({"directory": args.dir, "committed_steps": steps}))
     return 0
 

@@ -107,6 +107,15 @@ class TrainConfig:
     shard_opt_state: bool = False
     label_smoothing: float = 0.0
     ema_decay: float = 0.0  # 0 = off
+    # Hang watchdog: hard-exit the process (code 89) if no host-sync
+    # progress for this many seconds — converts a wedged accelerator
+    # backend (process alive, device sync never returns) into the process
+    # death the launcher's failure detection already handles: kill,
+    # restart, auto-resume from the last committed checkpoint. Must
+    # comfortably exceed one full logging interval + compile time
+    # (completed long host work — a slow checkpoint write — re-arms the
+    # timer rather than counting against it). 0 = off.
+    hang_timeout_s: float = 0.0
     # Gradient accumulation: split each global batch into this many
     # microbatches, lax.scan over them accumulating grads, apply the
     # optimizer once. Reproduces the reference recipes' pod-scale global

@@ -285,9 +285,13 @@ def resize_stack(
     half-cluster)."""
     store = store or StackStore()
     old = store.load(name)  # KeyError if the stack doesn't exist
-    if old.slice_type == new_slice_type:
+    if old.slice_type == new_slice_type and old.ready:
+        # Only a HEALTHY same-type stack makes resize a no-op; a
+        # CREATE_FAILED record at the target type must stay retryable
+        # with the same command (the natural recovery after a failed
+        # resize's create phase).
         raise ProvisionError(
-            f"stack {name!r} is already a {new_slice_type}")
+            f"stack {name!r} is already a ready {new_slice_type}")
     # Rebuild from the recorded create-time config; fall back to the
     # mirrored StackState fields for records from before create_config
     # existed.

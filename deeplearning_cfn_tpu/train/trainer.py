@@ -286,6 +286,16 @@ class Trainer:
         """
         from ..runtime.profiling import trace_steps as profiler_trace
 
+        watchdog = None
+        if self.cfg.train.hang_timeout_s > 0:
+            from ..runtime.watchdog import StepWatchdog
+
+            # First-compile happens inside the first sync window; give it
+            # the same budget again on top.
+            watchdog = StepWatchdog(
+                self.cfg.train.hang_timeout_s,
+                first_beat_grace_s=self.cfg.train.hang_timeout_s)
+
         step = int(state.step) if start_step is None else start_step
         trace_start = step + 1 if trace_dir and trace_steps > 0 else -1
         trace_stop = trace_start + trace_steps
@@ -335,12 +345,25 @@ class Trainer:
                     window_start = time.perf_counter()
                     window_examples = 0
                     last_realized = realized
+                    if watchdog is not None:
+                        # device_get above proved device-side progress.
+                        watchdog.beat()
 
                 # Hooks run every step (checkpoint cadence must not couple
                 # to log cadence); metrics arg is the last realized window,
                 # if any.
+                t_hooks = time.perf_counter()
                 for hook in hooks:
                     hook(step, state, last_realized)
+                if watchdog is not None and \
+                        time.perf_counter() - t_hooks > 1.0:
+                    # A hook that blocked for real host work (a slow
+                    # checkpoint write) and COMPLETED is liveness evidence
+                    # — beat so it can't eat the next window's budget. The
+                    # threshold keeps ordinary (sub-ms) hook calls from
+                    # beating every step, which would blind the watchdog
+                    # to device hangs behind async dispatch.
+                    watchdog.beat()
 
                 if (
                     eval_iter_fn is not None
@@ -355,8 +378,14 @@ class Trainer:
                                               for k, v in
                                               eval_metrics.items()}}
                         )
+                    if watchdog is not None:
+                        # A completed eval is progress too — don't let a
+                        # long eval eat the next window's budget.
+                        watchdog.beat()
             return state
         finally:
+            if watchdog is not None:
+                watchdog.stop()
             trace_stack.close()  # no-op unless exited mid-capture
             close = getattr(train_iter, "close", None)
             if close is not None:

@@ -136,6 +136,8 @@ def test_ckpt_list_and_rollback_verbs(tmp_path, capsys):
     assert json.loads(capsys.readouterr().out)["committed_steps"] == [2, 4]
 
     assert main(["ckpt", "rollback", d, "--step", "5"]) == 1
+    # A mistyped directory is an error, not an empty-but-successful list.
+    assert main(["ckpt", "list", d + "-typo"]) == 1
 
 
 def test_stack_status_missing(tmp_path):

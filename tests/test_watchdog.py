@@ -1,0 +1,111 @@
+"""Hang watchdog: the mechanism (timer/beat/stop semantics) and its
+trainer wiring. The real on_hang action is os._exit(89) — tests inject a
+recording action instead; the launcher-restart integration is covered by
+the launch tests' death-watch path (any nonzero exit restarts the job).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.runtime.watchdog import HANG_EXIT_CODE, StepWatchdog
+
+
+def _make(timeout_s, grace=0.0, poll=0.02):
+    fired = []
+    wd = StepWatchdog(timeout_s, first_beat_grace_s=grace,
+                      on_hang=fired.append, poll_interval_s=poll)
+    return wd, fired
+
+
+def test_beats_keep_it_alive():
+    wd, fired = _make(0.15)
+    try:
+        for _ in range(5):
+            time.sleep(0.05)
+            wd.beat()
+        assert not fired
+    finally:
+        wd.stop()
+
+
+def test_fires_on_stall():
+    wd, fired = _make(0.1)
+    try:
+        deadline = time.time() + 5.0
+        while not fired and time.time() < deadline:
+            time.sleep(0.02)
+        assert fired, "watchdog never fired on a stalled loop"
+        assert fired[0] >= 0.1  # reported stall covers at least the limit
+    finally:
+        wd.stop()
+
+
+def test_stop_prevents_firing():
+    wd, fired = _make(0.1)
+    wd.stop()
+    time.sleep(0.3)
+    assert not fired
+
+
+def test_first_beat_grace_extends_initial_deadline():
+    # grace 0.3 + timeout 0.1: must NOT fire in the first ~0.25s even
+    # without any beat (compile headroom), then fire once it lapses.
+    wd, fired = _make(0.1, grace=0.3)
+    try:
+        time.sleep(0.2)
+        assert not fired
+        deadline = time.time() + 5.0
+        while not fired and time.time() < deadline:
+            time.sleep(0.02)
+        assert fired
+    finally:
+        wd.stop()
+
+
+def test_rejects_nonpositive_timeout():
+    with pytest.raises(ValueError):
+        StepWatchdog(0.0)
+
+
+def test_exit_code_is_distinctive():
+    assert HANG_EXIT_CODE == 89
+
+
+def test_trainer_wires_watchdog(tmp_workdir, devices, monkeypatch):
+    """fit() with train.hang_timeout_s: the watchdog is created, beaten at
+    sync points (run survives, no fire), and stopped at loop end."""
+    import deeplearning_cfn_tpu.runtime.watchdog as wd_mod
+
+    created = []
+    real = wd_mod.StepWatchdog
+
+    class Recording(real):
+        def __init__(self, *a, **kw):
+            kw["on_hang"] = lambda s: created.append(("FIRED", s))
+            super().__init__(*a, **kw)
+            created.append(self)
+
+    monkeypatch.setattr(wd_mod, "StepWatchdog", Recording)
+
+    from deeplearning_cfn_tpu.config import apply_overrides
+    from deeplearning_cfn_tpu.presets import get_preset
+    from deeplearning_cfn_tpu.train.run import run_experiment
+
+    cfg = get_preset("cifar10_resnet20")
+    apply_overrides(cfg, [
+        f"workdir={tmp_workdir}", "train.global_batch=32",
+        "train.steps=6", "train.log_every_steps=2",
+        "train.hang_timeout_s=600", "data.num_train_examples=64",
+        "data.num_eval_examples=32", "train.eval_batch=32",
+        "schedule.name=constant", "schedule.warmup_epochs=0",
+        "checkpoint.async_write=false",
+    ])
+    metrics = run_experiment(cfg)
+    assert np.isfinite(metrics["loss"])
+    instances = [c for c in created if isinstance(c, Recording)]
+    fires = [c for c in created if isinstance(c, tuple)]
+    assert len(instances) == 1
+    assert not fires  # beats kept it alive through the whole run
+    assert instances[0]._stopped.is_set()  # stopped when fit returned
