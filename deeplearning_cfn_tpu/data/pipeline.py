@@ -354,7 +354,7 @@ def build_pipeline(
             drop_remainder=drop_remainder,
         )
 
-    if name in ("wikipedia_mlm", "wmt_en_de", "coco"):
+    if name in ("wikipedia_mlm", "wmt_en_de", "lm_text", "coco"):
         from .text import build_text_source
         from .detection import build_detection_source
 

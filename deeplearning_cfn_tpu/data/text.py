@@ -152,6 +152,24 @@ _MLM_KEYS = ("input_ids", "input_mask", "segment_ids", "mlm_positions",
 _NMT_KEYS = ("src_ids", "src_mask", "tgt_in_ids", "tgt_out_ids", "tgt_mask")
 
 
+_LM_KEYS = ("tokens", "loss_mask")
+
+
+def make_lm_source(num_examples: int, seq_len: int, vocab_size: int,
+                   seed: int) -> ArraySource:
+    """Causal-LM examples: ``tokens [N, seq_len+1]`` (model consumes
+    tokens[:, :-1], predicts tokens[:, 1:]) + ``loss_mask [N, seq_len]``
+    over the predicted positions. Synthetic tokens follow the same fixed
+    Markov chain as the MLM source, so next-token loss falls fast below
+    unigram entropy — a learnable convergence signal."""
+    rng = np.random.RandomState(seed)
+    tokens = _markov_tokens(rng, num_examples, seq_len + 1, vocab_size)
+    return ArraySource({
+        "tokens": tokens.astype(np.int32),
+        "loss_mask": np.ones((num_examples, seq_len), np.float32),
+    })
+
+
 def _load_npz_dir(data_dir: str, split: str, keys) -> ArraySource:
     """Real-data path: ``<data_dir>/<split>.npz`` holding the listed keys."""
     path = os.path.join(data_dir, f"{split}.npz")
@@ -168,7 +186,8 @@ def _load_npz_dir(data_dir: str, split: str, keys) -> ArraySource:
 
 def build_text_source(cfg: DataConfig, train: bool) -> ArraySource:
     split = "train" if train else "eval"
-    keys = _MLM_KEYS if cfg.name == "wikipedia_mlm" else _NMT_KEYS
+    keys = {"wikipedia_mlm": _MLM_KEYS, "lm_text": _LM_KEYS} \
+        .get(cfg.name, _NMT_KEYS)
     if cfg.data_dir and not cfg.synthetic:
         return _load_npz_dir(cfg.data_dir, split, keys)
     n = cfg.num_train_examples or 4096
@@ -179,4 +198,6 @@ def build_text_source(cfg: DataConfig, train: bool) -> ArraySource:
         return make_mlm_source(n, cfg.seq_len, cfg.vocab_size, seed)
     if cfg.name == "wmt_en_de":
         return make_nmt_source(n, cfg.seq_len, cfg.vocab_size, seed)
+    if cfg.name == "lm_text":
+        return make_lm_source(n, cfg.seq_len, cfg.vocab_size, seed)
     raise KeyError(f"unknown text dataset {cfg.name!r}")

@@ -230,6 +230,31 @@ def _maskrcnn() -> ExperimentConfig:
     )
 
 
+@register_preset("gpt_small_lm")
+def _gpt_small() -> ExperimentConfig:
+    """GPT-2-small decoder-only LM pretraining — beyond the reference's
+    workload era (its newest family is BERT); included because one causal
+    trunk exercises flash causal attention, KV-cached decode, TP rules,
+    and gradient accumulation together (models/lm.py). Recipe: GPT-2/124M
+    dims, AdamW(0.9, 0.95) wd 0.1, cosine to zero after linear warmup,
+    grad clip 1.0 — the now-standard small-LM pretraining recipe."""
+    return ExperimentConfig(
+        model=ModelConfig(
+            name="gpt_small",
+            kwargs=dict(max_len=1024, dropout_rate=0.1),
+        ),
+        data=DataConfig(name="lm_text", seq_len=1024, vocab_size=32768),
+        train=TrainConfig(global_batch=512, steps=100_000, dtype="bfloat16",
+                          grad_accum_steps=1, shard_opt_state=True),
+        optimizer=OptimizerConfig(name="adamw", b1=0.9, b2=0.95,
+                                  weight_decay=0.1, grad_clip_norm=1.0),
+        schedule=ScheduleConfig(name="cosine", base_lr=6e-4,
+                                warmup_steps=2000),
+        mesh=MeshConfig(data=-1),
+        stack=StackConfig(slice_type="v5p-32"),
+    )
+
+
 @register_preset("transformer_nmt_wmt")
 def _nmt() -> ExperimentConfig:
     """Transformer NMT WMT En-De (reference: Sockeye + MXNet

@@ -81,7 +81,8 @@ def run_experiment(
 
     trainer = Trainer(cfg, task.loss_fn, tx, mesh=mesh,
                       spatial_dim=getattr(task, "spatial_dim", None),
-                      spatial_keys=getattr(task, "spatial_keys", None))
+                      spatial_keys=getattr(task, "spatial_keys", None),
+                      eval_derived=getattr(task, "eval_derived", None))
     metrics_path = os.path.join(workdir, "metrics.jsonl")
     writer = MetricsWriter(metrics_path)
     if jax.process_index() == 0:

@@ -356,6 +356,71 @@ class Seq2SeqTask:
         return loss, aux
 
 
+class CausalLmTask:
+    """Decoder-only next-token pretraining (GPT family — beyond the
+    reference's workload era; models/lm.py explains why it earns a slot).
+
+    Loss = token-weighted mean cross-entropy of tokens[:, 1:] given
+    tokens[:, :-1]; metrics include perplexity and next-token accuracy.
+    Batch contract: data/text.py make_lm_source.
+    """
+
+    exact_eval = True
+
+    def __init__(self, cfg: ExperimentConfig, mesh=None):
+        from ..models.lm import PARAM_RULES
+
+        self.cfg = cfg
+        dtype = jnp.bfloat16 if cfg.train.dtype == "bfloat16" else jnp.float32
+        kwargs = dict(cfg.model.kwargs)
+        kwargs.setdefault("vocab_size", cfg.data.vocab_size)
+        kwargs.setdefault("max_len", max(cfg.data.seq_len, 128))
+        self.param_rules = PARAM_RULES
+        self.model = build_model(cfg.model.name, 0, dtype, **kwargs)
+        self.remat = cfg.train.remat
+
+    def init(self, rng: jax.Array):
+        ids = jnp.zeros((1, self.cfg.data.seq_len), jnp.int32)
+        return self.model.init(rng, ids, train=False)
+
+    def loss_fn(self, params, batch_stats, batch, rng, train):
+        rngs = {"dropout": rng} if (train and rng is not None) else None
+        apply = lambda p, ids: self.model.apply(
+            {"params": p}, ids, train=train, rngs=rngs)
+        if train and self.remat:
+            apply = jax.checkpoint(apply)
+        inputs = batch["tokens"][:, :-1]
+        targets = batch["tokens"][:, 1:]
+        logits = apply(params, inputs)
+        mask = example_mask(batch, inputs.shape[0])
+        weights = batch["loss_mask"] * mask[:, None]
+        ce = cross_entropy(logits, targets)
+        denom = jnp.maximum(jnp.sum(weights), 1e-6)
+        loss = jnp.sum(ce * weights) / denom
+        hits = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+        aux = {"token_accuracy": jnp.sum(hits * weights) / denom}
+        if train:
+            # Per-step perplexity for the train log only: exp of THIS
+            # step's token-mean CE (clipped against random-init overflow).
+            # Eval perplexity is derived post-aggregation instead — a
+            # weighted mean of per-batch exp(CE) is not perplexity
+            # (Jensen); see eval_derived below.
+            aux["perplexity"] = jnp.exp(jnp.minimum(loss, 20.0))
+            aux["batch_stats"] = batch_stats
+        else:
+            # Every eval metric here (incl. the loss) is token-weighted:
+            # the default normalizer is the batch's real token count, so
+            # cross-batch aggregation yields the exact full-set token-mean
+            # even with ragged loss_masks or padded eval tails.
+            aux["eval_weight"] = jnp.sum(weights)
+        return loss, aux
+
+    # Derived post-aggregation (Trainer.evaluate): exact perplexity.
+    eval_derived = {
+        "perplexity": lambda m: float(np.exp(min(m["loss"], 20.0))),
+    }
+
+
 def build_task(cfg: ExperimentConfig, mesh=None):
     """Task registry keyed by model family.
 
@@ -367,6 +432,8 @@ def build_task(cfg: ExperimentConfig, mesh=None):
     name = cfg.model.name
     if name.startswith("resnet"):
         return ClassificationTask(cfg)
+    if name.startswith("gpt"):
+        return CausalLmTask(cfg, mesh=mesh)
     if name.startswith("bert"):
         return MlmTask(cfg, mesh=mesh)
     if name.startswith("transformer_nmt"):

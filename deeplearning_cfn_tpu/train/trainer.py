@@ -87,6 +87,8 @@ class Trainer:
         spatial_dim: Optional[int] = None,
         spatial_keys: Optional[Tuple[str, ...]] = None,
         donate: bool = True,
+        eval_derived: Optional[Dict[str, Callable[[Dict[str, float]],
+                                                  float]]] = None,
     ):
         self.cfg = cfg
         self.loss_fn = loss_fn
@@ -109,6 +111,12 @@ class Trainer:
         self._train_step = None
         self._eval_step = None
         self._donate = donate
+        # Post-aggregation metric transforms (task.eval_derived): computed
+        # from the EXACT cross-batch aggregates, for metrics that are a
+        # nonlinear function of a mean — perplexity = exp(mean CE) is not
+        # the mean of per-batch exp(CE) (Jensen), so it cannot be a
+        # per-batch eval metric.
+        self.eval_derived = dict(eval_derived or {})
 
     # -- sharding helpers ---------------------------------------------------
 
@@ -419,5 +427,7 @@ class Trainer:
                 wsums[k] = wsums.get(k, 0.0) + w
         out = {k: totals[k] / max(wsums[k], 1e-9) for k in totals}
         out["examples"] = examples
+        for name, fn in self.eval_derived.items():
+            out[name] = float(fn(out))
         return out
 

@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# On-chip measurement session: run everything worth measuring on the real
+# TPU in one unattended pass, appending JSON lines + markers to a log.
+# Usage: tools/chip_session.sh [LOGFILE]   (default /tmp/chip_session.log)
+#
+# Designed for the flaky-backend reality: every stage is its own process
+# with a hard timeout, failures don't stop later stages, and the log
+# records wall-clock per stage. Order: cheapest/highest-value first, so a
+# mid-session backend death still leaves the headline numbers.
+
+set -u
+LOG="${1:-/tmp/chip_session.log}"
+cd "$(dirname "$0")/.."
+
+stage() {
+  local name="$1" tmo="$2"; shift 2
+  echo "=== [$(date +%H:%M:%S)] $name (timeout ${tmo}s) ===" >> "$LOG"
+  timeout "$tmo" "$@" >> "$LOG" 2>&1
+  echo "--- rc=$? [$(date +%H:%M:%S)] $name done" >> "$LOG"
+}
+
+echo "==== chip session start $(date) ====" >> "$LOG"
+
+# 0. Preflight: is the backend even alive? (doctor exits 1 on failure —
+#    later stages still run, in case the hang was transient.)
+stage doctor            180 python -m deeplearning_cfn_tpu.cli doctor
+
+# 1. Headline driver bench (ResNet-50, full contract line).
+stage bench_headline    560 python bench.py
+
+# 2. ResNet batch sweep around the shipped 512 default.
+stage sweep_resnet      900 python -m deeplearning_cfn_tpu.cli bench \
+    --preset imagenet_resnet50 --steps 20 --sweep-batches 384,512,640
+
+# 3. Stem A/B: classic 7x7 vs space-to-depth, full fwd+bwd at 224/b512.
+stage ops_resnet        900 python -m deeplearning_cfn_tpu.cli bench \
+    --ops resnet --steps 10 --global-batch 512
+
+# 4. Detection step breakdown (the 0.05-MFU diagnosis).
+stage ops_detection    1500 python -m deeplearning_cfn_tpu.cli bench \
+    --ops detection --steps 5
+
+# 5. Per-preset step benches not covered above.
+for p in bert_base_wikipedia transformer_nmt_wmt maskrcnn_coco \
+         bert_moe_wikipedia bert_long_wikipedia; do
+  stage "bench_$p"      700 python -m deeplearning_cfn_tpu.cli bench \
+      --preset "$p" --steps 20
+done
+
+# 6. Feed-included flagship number (trained throughput).
+stage bench_with_input  700 python -m deeplearning_cfn_tpu.cli bench \
+    --preset imagenet_resnet50 --steps 20 --with-input
+
+echo "==== chip session end $(date) ====" >> "$LOG"
