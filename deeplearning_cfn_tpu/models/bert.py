@@ -22,8 +22,10 @@ from . import register_model
 from .moe import MOE_PARAM_RULES
 from .transformer import (
     Embed,
+    MoeAuxAccumulator,
     TRANSFORMER_PARAM_RULES,
     TransformerLayer,
+    is_moe_layer,
     padding_bias,
 )
 
@@ -87,12 +89,9 @@ class BertEncoder(nn.Module):
             dropout_rate=self.dropout_rate, name="embed",
         )(input_ids, segment_ids, deterministic=deterministic)
         bias = padding_bias(input_mask)
-        moe_aux = {"load_balance": jnp.zeros((), jnp.float32),
-                   "router_z": jnp.zeros((), jnp.float32)}
-        n_moe = 0
+        acc = MoeAuxAccumulator()
         for i in range(self.num_layers):
-            is_moe = (self.num_experts > 0
-                      and i % self.moe_every == self.moe_every - 1)
+            is_moe = is_moe_layer(i, self.num_experts, self.moe_every)
             layer = TransformerLayer(
                 self.num_heads, self.mlp_dim, self.dtype,
                 self.dropout_rate, prenorm=False,
@@ -104,13 +103,10 @@ class BertEncoder(nn.Module):
             if is_moe:
                 x, aux = layer(x, self_bias=bias,
                                deterministic=deterministic)
-                moe_aux = {k: moe_aux[k] + aux[k] for k in moe_aux}
-                n_moe += 1
+                acc.add(aux)
             else:
                 x = layer(x, self_bias=bias, deterministic=deterministic)
-        if n_moe:
-            moe_aux = {k: v / n_moe for k, v in moe_aux.items()}
-        return x, token_emb, moe_aux
+        return x, token_emb, acc.mean()
 
 
 class BertPretrain(nn.Module):

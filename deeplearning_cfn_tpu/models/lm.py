@@ -20,11 +20,18 @@ import jax
 import jax.numpy as jnp
 
 from . import register_model
-from .transformer import TRANSFORMER_PARAM_RULES, TransformerLayer
+from .moe import MOE_PARAM_RULES
+from .transformer import (
+    MoeAuxAccumulator,
+    TRANSFORMER_PARAM_RULES,
+    TransformerLayer,
+    is_moe_layer,
+)
 
 Dtype = Any
 
-PARAM_RULES = TRANSFORMER_PARAM_RULES
+# MoE rules are harmless when no MoE layers exist (regexes match nothing).
+PARAM_RULES = TRANSFORMER_PARAM_RULES + MOE_PARAM_RULES
 
 
 class TransformerCausalLm(nn.Module):
@@ -46,6 +53,16 @@ class TransformerCausalLm(nn.Module):
     dtype: Dtype = jnp.bfloat16
     dropout_rate: float = 0.0
     attention_impl: str = "auto"
+    # num_experts > 0 turns every moe_every-th block's FFN into a
+    # Mixture-of-Experts FFN (GShard's every-other-layer convention);
+    # __call__ then returns (logits, moe_aux) — bert.py's contract.
+    num_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_top_k: int = 2
+
+    def _is_moe(self, i: int) -> bool:
+        return is_moe_layer(i, self.num_experts, self.moe_every)
 
     def setup(self):
         self.token = nn.Embed(self.vocab_size, self.hidden_size,
@@ -62,6 +79,9 @@ class TransformerCausalLm(nn.Module):
                 self.num_heads, self.mlp_dim, dtype=self.dtype,
                 dropout_rate=self.dropout_rate, prenorm=True,
                 attention_impl=self.attention_impl,
+                num_experts=self.num_experts if self._is_moe(i) else 0,
+                moe_capacity_factor=self.moe_capacity_factor,
+                moe_top_k=self.moe_top_k,
                 name=f"layer_{i}")
             for i in range(self.num_layers)
         ]
@@ -78,21 +98,32 @@ class TransformerCausalLm(nn.Module):
     def __call__(self, tokens, train: bool = False):
         x = self._embed(tokens,
                         self.position[None, :tokens.shape[1], :], train)
-        for lyr in self.layers:
-            x = lyr(x, causal=True, deterministic=not train)
+        acc = MoeAuxAccumulator()
+        for i, lyr in enumerate(self.layers):
+            if self._is_moe(i):
+                x, aux = lyr(x, causal=True, deterministic=not train)
+                acc.add(aux)
+            else:
+                x = lyr(x, causal=True, deterministic=not train)
         x = self.final_norm(x)
-        return self.token.attend(x.astype(jnp.float32))
+        logits = self.token.attend(x.astype(jnp.float32))
+        if self.num_experts > 0:
+            return logits, acc.mean()
+        return logits
 
     def decode_step(self, token, pos):
         """``token`` [B, 1] at position ``pos`` → logits [B, 1, V] for
         position ``pos + 1``, appending this position's K/V to the
-        cache."""
+        cache. MoE aux losses are a training concern; decode discards
+        them."""
         pos_emb = jax.lax.dynamic_slice(
             self.position, (pos, 0), (1, self.hidden_size))[None, :, :]
         x = self._embed(token, pos_emb, train=False)
-        for lyr in self.layers:
+        for i, lyr in enumerate(self.layers):
             x = lyr(x, causal=True, deterministic=True, decode=True,
                     max_decode_len=self.max_len)
+            if self._is_moe(i):
+                x = x[0]
         x = self.final_norm(x)
         return self.token.attend(x.astype(jnp.float32))
 

@@ -259,6 +259,31 @@ class Embed(nn.Module):
         return x, emb
 
 
+def is_moe_layer(i: int, num_experts: int, moe_every: int) -> bool:
+    """GShard's every-``moe_every``-th-layer convention, shared by every
+    trunk that hosts MoE FFNs (bert, gpt) so the layer-selection rule
+    can't silently diverge between them."""
+    return num_experts > 0 and i % moe_every == moe_every - 1
+
+
+class MoeAuxAccumulator:
+    """Accumulate MoE aux losses across a trunk's MoE layers and return
+    their per-layer mean — the one aggregation rule both bert and gpt
+    use. Keys mirror MoeMlp's aux dict."""
+
+    def __init__(self):
+        self.totals = {"load_balance": jnp.zeros((), jnp.float32),
+                       "router_z": jnp.zeros((), jnp.float32)}
+        self.n = 0
+
+    def add(self, aux) -> None:
+        self.totals = {k: self.totals[k] + aux[k] for k in self.totals}
+        self.n += 1
+
+    def mean(self):
+        return {k: v / max(self.n, 1) for k, v in self.totals.items()}
+
+
 def padding_bias(mask: jnp.ndarray) -> jnp.ndarray:
     """[B, S] 1/0 attention mask → additive bias [B, 1, 1, S]."""
     return jnp.where(mask.astype(bool), 0.0, -1e30)[:, None, None, :] \

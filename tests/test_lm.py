@@ -49,17 +49,25 @@ def test_lm_is_causal():
     assert not np.allclose(np.asarray(base[0, 8:]), np.asarray(out[0, 8:]))
 
 
-def test_lm_kv_cache_decode_matches_full_forward():
+@pytest.mark.parametrize("num_experts", [0, 2])
+def test_lm_kv_cache_decode_matches_full_forward(num_experts):
     """Incremental decode through the KV cache must reproduce the full
     forward's logits position by position — the correctness claim behind
-    cached generation."""
+    cached generation (including through MoE FFN layers, whose routing
+    is per-token and so decode-invariant)."""
+    # capacity_factor high enough that the full-sequence pass drops no
+    # tokens — per-position decode never drops (1 token vs capacity>=1),
+    # so drop-free routing is a precondition for exact parity.
     model = build_model("gpt_tiny", 0, jnp.float32, vocab_size=32,
-                        max_len=16, dropout_rate=0.0)
+                        max_len=16, dropout_rate=0.0,
+                        num_experts=num_experts, moe_capacity_factor=4.0)
     T = 10
     ids = (jax.random.randint(jax.random.PRNGKey(1), (1, T), 0, 32)
            .astype(jnp.int32))
     variables = model.init(jax.random.PRNGKey(0), ids, train=False)
-    full = model.apply(variables, ids, train=False)  # [1, T, V]
+    full = model.apply(variables, ids, train=False)
+    if num_experts:
+        full = full[0]  # (logits, moe_aux) when MoE layers exist
 
     # Create the cache via a decode_step init (the documented contract).
     from deeplearning_cfn_tpu.models.lm import TransformerCausalLm
@@ -109,8 +117,53 @@ def test_lm_trains_end_to_end(tmp_workdir):
     assert "perplexity" in final and "token_accuracy" in final
     assert final["perplexity"] < np.exp(first["loss"])
     # Derived post-aggregation, so it must be exactly exp of the exact
-    # token-weighted eval loss (not a mean of per-batch exps).
-    assert final["perplexity"] == pytest.approx(np.exp(final["loss"]))
+    # token-weighted eval CE (not a mean of per-batch exps; without MoE
+    # layers ce_loss == loss).
+    assert final["perplexity"] == pytest.approx(np.exp(final["ce_loss"]))
+    assert final["ce_loss"] == pytest.approx(final["loss"])
+
+
+def test_lm_moe_trains_and_shards_experts(tmp_workdir, devices):
+    """gpt with num_experts: MoE aux losses thread into the objective and
+    expert weights shard over the 'expert' mesh axis (the GShard
+    convention the bert_moe flagship uses)."""
+    from deeplearning_cfn_tpu.parallel import build_mesh
+    from deeplearning_cfn_tpu.train import create_train_state
+    from deeplearning_cfn_tpu.train.optim import build_optimizer, build_schedule
+    from deeplearning_cfn_tpu.train.task import build_task
+    from deeplearning_cfn_tpu.train.trainer import Trainer
+
+    cfg = ExperimentConfig(
+        model=ModelConfig(name="gpt_tiny",
+                          kwargs=dict(vocab_size=64, max_len=32,
+                                      num_experts=2)),
+        data=DataConfig(name="lm_text", seq_len=32, vocab_size=64,
+                        num_train_examples=64, num_eval_examples=32,
+                        prefetch=0),
+        train=TrainConfig(global_batch=16, dtype="float32"),
+        mesh=MeshConfig(data=4, expert=2),
+    )
+    mesh = build_mesh(cfg.mesh)
+    task = build_task(cfg)
+    sched = build_schedule(cfg.schedule, 4, 16, 4)
+    tx = build_optimizer(cfg.optimizer, sched)
+    state = create_train_state(jax.random.PRNGKey(0), task.init, tx, mesh,
+                               param_rules=task.param_rules)
+    n_expert_sharded = 0
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        spec = getattr(leaf.sharding, "spec", None)
+        if spec and any(ax == "expert" for ax in spec if ax):
+            n_expert_sharded += 1
+    assert n_expert_sharded >= 2, n_expert_sharded  # 1 MoE layer's w1/w2
+
+    from deeplearning_cfn_tpu.data import build_pipeline
+
+    trainer = Trainer(cfg, task.loss_fn, tx, mesh=mesh)
+    pipe = build_pipeline(cfg.data, 16, 0, seed=0, train=True)
+    batch = trainer.device_batch(next(iter(pipe.one_epoch(0))))
+    state, metrics = trainer.train_step(state, batch, jax.random.PRNGKey(1))
+    assert np.isfinite(float(metrics["loss"]))
+    assert "moe_load_balance" in metrics
 
 
 def test_lm_tensor_parallel_shards_kernels(tmp_workdir, devices):
