@@ -223,6 +223,18 @@ def run_bench(
         "mesh": {k: int(v) for k, v in mesh.shape.items()},
         "measured": True,
     }
+    # Post-run HBM occupancy (PJRT memory_stats; absent on CPU): how close
+    # the chosen batch runs to the chip's limit — context for batch sweeps.
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        if "bytes_in_use" in stats:
+            record["hbm_gib_in_use"] = round(
+                stats["bytes_in_use"] / 2**30, 2)
+        if "bytes_limit" in stats:
+            record["hbm_gib_limit"] = round(
+                stats["bytes_limit"] / 2**30, 2)
+    except Exception:
+        pass
 
     if include_input:
         stage("timed_with_input", steps=steps)

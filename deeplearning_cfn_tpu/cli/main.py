@@ -349,6 +349,14 @@ def _cmd_doctor(args) -> int:
             val = float((x @ x).sum())  # executes + syncs one real program
             report("device-exec", val == 128.0 * 128 * 128,
                    f"matmul sum={val:.0f}")
+            try:
+                stats = devices[0].memory_stats() or {}
+            except Exception:
+                stats = {}  # some PJRT plugins raise instead of None
+            if "bytes_limit" in stats:
+                report("hbm", True,
+                       f"{stats.get('bytes_in_use', 0) / 2**30:.2f} / "
+                       f"{stats['bytes_limit'] / 2**30:.2f} GiB in use")
             from ..config import MeshConfig
             from ..parallel.mesh import build_mesh, describe
 
@@ -395,6 +403,24 @@ def _cmd_data_prepare_imagenet(args) -> int:
     n = sum(s["num_records"] for s in index["shards"])
     print(f"[dlcfn-tpu] wrote {n} records in {len(index['shards'])} shards "
           f"({index['num_classes']} classes) to {args.out}")
+    return 0
+
+
+def _cmd_data_prepare_text(args) -> int:
+    from ..data.text import prepare_lm_text
+
+    try:
+        info = prepare_lm_text(args.src, args.out, args.seq_len,
+                               args.eval_fraction)
+    except (OSError, ValueError) as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    print(f"[dlcfn-tpu] wrote {info['train_examples']} train / "
+          f"{info['eval_examples']} eval examples to {args.out}; train "
+          f"with: --preset gpt_small_lm data.name=lm_text "
+          f"data.data_dir={args.out} data.synthetic=false "
+          f"data.vocab_size={info['vocab_size']} "
+          f"data.seq_len={info['seq_len']}")
     return 0
 
 
@@ -565,6 +591,16 @@ def build_parser() -> argparse.ArgumentParser:
     dp.add_argument("--limit", type=int, default=0,
                     help="stop after N images (smoke tests)")
     dp.set_defaults(fn=_cmd_data_prepare_imagenet)
+
+    dt = dsub.add_parser(
+        "prepare-text",
+        help="tokenize a raw text file (byte-level, offline) into the "
+             "lm_text train/eval npz contract")
+    dt.add_argument("--src", required=True, help="raw text/bytes file")
+    dt.add_argument("--out", required=True, help="output directory")
+    dt.add_argument("--seq-len", type=int, default=1024)
+    dt.add_argument("--eval-fraction", type=float, default=0.05)
+    dt.set_defaults(fn=_cmd_data_prepare_text)
 
     df = dsub.add_parser(
         "feed-rate",

@@ -170,6 +170,40 @@ def make_lm_source(num_examples: int, seq_len: int, vocab_size: int,
     })
 
 
+def prepare_lm_text(src_path: str, out_dir: str, seq_len: int,
+                    eval_fraction: float = 0.05) -> Dict[str, int]:
+    """Tokenize a raw text/bytes file into the ``lm_text`` npz contract.
+
+    Byte-level vocabulary (the fully-offline tokenizer: 256 byte values
+    shifted past the 4 reserved special ids → ``data.vocab_size=260``),
+    chunked into non-overlapping ``seq_len + 1`` windows, split into
+    ``train.npz`` / ``eval.npz`` under ``out_dir``. Returns counts.
+    The reference's text workloads assumed an offline tokenization step
+    too (create_pretraining_data.py, Sockeye's prepare-data); this is
+    that step for the LM family, with no vocab download required.
+    """
+    if not 0.0 < eval_fraction < 1.0:
+        raise ValueError(
+            f"eval_fraction must be in (0, 1), got {eval_fraction}")
+    with open(src_path, "rb") as f:
+        raw = np.frombuffer(f.read(), np.uint8)
+    window = seq_len + 1
+    n = len(raw) // window
+    if n < 2:
+        raise ValueError(
+            f"{src_path}: need at least {2 * window} bytes for one train "
+            f"and one eval window of seq_len+1={window}, got {len(raw)}")
+    tokens = raw[:n * window].reshape(n, window).astype(np.int32) + 4
+    n_eval = min(max(1, int(n * eval_fraction)), n - 1)
+    os.makedirs(out_dir, exist_ok=True)
+    splits = {"train": tokens[:-n_eval], "eval": tokens[-n_eval:]}
+    for split, toks in splits.items():
+        np.savez(os.path.join(out_dir, f"{split}.npz"), tokens=toks,
+                 loss_mask=np.ones((len(toks), seq_len), np.float32))
+    return {"train_examples": n - n_eval, "eval_examples": n_eval,
+            "vocab_size": 260, "seq_len": seq_len}
+
+
 def _load_npz_dir(data_dir: str, split: str, keys) -> ArraySource:
     """Real-data path: ``<data_dir>/<split>.npz`` holding the listed keys."""
     path = os.path.join(data_dir, f"{split}.npz")

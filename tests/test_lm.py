@@ -35,6 +35,35 @@ def test_lm_source_invariants():
                                   again.gather(np.arange(64))["tokens"])
 
 
+def test_prepare_lm_text_roundtrip(tmp_path):
+    """prepare-text → real-data lm_text pipeline → a training step: the
+    fully-offline byte-level path."""
+    from deeplearning_cfn_tpu.data.text import build_text_source, \
+        prepare_lm_text
+
+    src = tmp_path / "corpus.txt"
+    src.write_bytes(bytes(range(256)) * 40)  # 10240 bytes
+    out = str(tmp_path / "tok")
+    info = prepare_lm_text(str(src), out, seq_len=31)
+    assert info["train_examples"] + info["eval_examples"] == 10240 // 32
+    assert info["vocab_size"] == 260
+
+    cfg = DataConfig(name="lm_text", seq_len=31, vocab_size=260,
+                     data_dir=out, synthetic=False)
+    train_src = build_text_source(cfg, train=True)
+    batch = train_src.gather(np.arange(4))
+    assert batch["tokens"].shape == (4, 32)
+    # Byte values shifted past the 4 reserved specials.
+    assert batch["tokens"].min() >= 4 and batch["tokens"].max() < 260
+
+    with pytest.raises(ValueError, match="at least"):
+        tiny = tmp_path / "tiny.txt"
+        tiny.write_bytes(b"x" * 10)
+        prepare_lm_text(str(tiny), out, seq_len=31)
+    with pytest.raises(ValueError, match="eval_fraction"):
+        prepare_lm_text(str(src), out, seq_len=31, eval_fraction=1.5)
+
+
 def test_lm_is_causal():
     """Changing a future token must not change past logits."""
     model = build_model("gpt_tiny", 0, jnp.float32, vocab_size=32,
