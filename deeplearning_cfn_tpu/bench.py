@@ -227,6 +227,11 @@ def run_bench(
     # the chosen batch runs to the chip's limit — context for batch sweeps.
     try:
         stats = jax.local_devices()[0].memory_stats() or {}
+        # Peak is the batch-headroom number (post-run bytes_in_use has
+        # already dropped the step's activation temporaries).
+        if "peak_bytes_in_use" in stats:
+            record["hbm_gib_peak"] = round(
+                stats["peak_bytes_in_use"] / 2**30, 2)
         if "bytes_in_use" in stats:
             record["hbm_gib_in_use"] = round(
                 stats["bytes_in_use"] / 2**30, 2)

@@ -221,6 +221,69 @@ def beam_decode_cached(model, variables, src_ids, src_mask, max_len: int,
     return best_tokens, best_scores
 
 
+def lm_generate(model, variables, prompt_ids, max_new_tokens: int,
+                temperature: float = 0.0, top_k: int = 0,
+                rng=None) -> jnp.ndarray:
+    """KV-cached autoregressive generation for the causal LM family
+    (models/lm.py TransformerCausalLm).
+
+    ``prompt_ids`` [B, P] (P >= 1) → [B, P + max_new_tokens]. One
+    fixed-length ``lax.scan`` over P + N - 1 positions: prompt positions
+    prime the cache (their "prediction" is discarded in favor of the real
+    next prompt token), generated positions append. ``temperature == 0``
+    is greedy argmax; otherwise softmax sampling at that temperature,
+    optionally truncated to the ``top_k`` highest logits (``rng``
+    required). Static shapes throughout; jit-compatible.
+    """
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng")
+    b, p = prompt_ids.shape
+    total = p + max_new_tokens
+    max_len = getattr(model, "max_len", None)
+    if max_len is not None and total > max_len:
+        # Out-of-range dynamic_slice indices CLAMP (no error): past
+        # max_len the cache's last slot would be silently overwritten and
+        # the output degenerates. Fail loudly instead.
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) = {total} "
+            f"exceeds the model's max_len ({max_len})")
+    decode_step = type(model).decode_step
+    cache = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((b, 1), jnp.int32), 0,
+        method=decode_step)["cache"]
+    tokens = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt_ids)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def step(carry, t):
+        tokens, cache, rng = carry
+        tok = jax.lax.dynamic_slice(tokens, (0, t), (b, 1))
+        logits, mut = model.apply(
+            {"params": variables["params"], "cache": cache}, tok, t,
+            method=decode_step, mutable=["cache"])
+        logits = logits[:, 0, :]
+        if temperature > 0.0:
+            rng, sub = jax.random.split(rng)
+            scaled = logits / temperature
+            if top_k > 0:
+                kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            nxt = jax.random.categorical(sub, scaled).astype(jnp.int32)
+        else:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # Prompt positions keep their real token; generated positions
+        # take the model's choice.
+        keep_prompt = (t + 1) < p
+        cur = jax.lax.dynamic_slice(tokens, (0, t + 1), (b, 1))[:, 0]
+        nxt = jnp.where(keep_prompt, cur, nxt)
+        tokens = jax.lax.dynamic_update_slice(tokens, nxt[:, None],
+                                              (0, t + 1))
+        return (tokens, mut["cache"], rng), None
+
+    (tokens, _, _), _ = jax.lax.scan(
+        step, (tokens, cache, rng), jnp.arange(total - 1))
+    return tokens
+
+
 def strip_special(ids) -> list:
     """Token-id row → python list up to (excluding) EOS, dropping PAD/BOS."""
     out = []

@@ -152,6 +152,51 @@ def test_lm_trains_end_to_end(tmp_workdir):
     assert final["ce_loss"] == pytest.approx(final["loss"])
 
 
+def test_lm_generate_greedy_matches_manual_rollout():
+    """lm_generate(temperature=0) must equal the brute-force rollout that
+    re-runs the FULL forward and takes argmax of the last position each
+    step — the cached scan is an optimization, not a different sampler."""
+    from deeplearning_cfn_tpu.models.decoding import lm_generate
+
+    model = build_model("gpt_tiny", 0, jnp.float32, vocab_size=32,
+                        max_len=16, dropout_rate=0.0)
+    prompt = jnp.array([[5, 9, 3], [1, 2, 7]], jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), prompt, train=False)
+
+    out = lm_generate(model, variables, prompt, max_new_tokens=6)
+    assert out.shape == (2, 9)
+    np.testing.assert_array_equal(np.asarray(out[:, :3]),
+                                  np.asarray(prompt))
+
+    manual = prompt
+    for _ in range(6):
+        logits = model.apply(variables, manual, train=False)
+        nxt = jnp.argmax(logits[:, -1, :], -1).astype(jnp.int32)
+        manual = jnp.concatenate([manual, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(manual))
+
+
+def test_lm_generate_sampling_is_seeded_and_in_vocab():
+    from deeplearning_cfn_tpu.models.decoding import lm_generate
+
+    model = build_model("gpt_tiny", 0, jnp.float32, vocab_size=32,
+                        max_len=16, dropout_rate=0.0)
+    prompt = jnp.array([[4, 8]], jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), prompt, train=False)
+    a = lm_generate(model, variables, prompt, 5, temperature=1.0,
+                    top_k=8, rng=jax.random.PRNGKey(7))
+    b = lm_generate(model, variables, prompt, 5, temperature=1.0,
+                    top_k=8, rng=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(a.min()) >= 0 and int(a.max()) < 32
+    with pytest.raises(ValueError, match="rng"):
+        lm_generate(model, variables, prompt, 5, temperature=1.0)
+    # Generating past max_len would silently clamp the cache writes —
+    # it must refuse instead.
+    with pytest.raises(ValueError, match="max_len"):
+        lm_generate(model, variables, prompt, 15)
+
+
 def test_lm_moe_trains_and_shards_experts(tmp_workdir, devices):
     """gpt with num_experts: MoE aux losses thread into the objective and
     expert weights shard over the 'expert' mesh axis (the GShard
