@@ -39,6 +39,7 @@ _UNITS = {
     "bert_pipelined_wikipedia": "sequences/sec/chip",
     "bert_long_wikipedia": "sequences/sec/chip",
     "gpt_small_lm": "sequences/sec/chip",
+    "imagenet_vit_s16": "images/sec/chip",
 }
 
 # Peak dense bf16 FLOPs/sec per chip, keyed by device_kind substring.
@@ -138,7 +139,8 @@ def run_bench(
                     # seq-4096 activations: batch 8 fits one 16 GB chip
                     "bert_long_wikipedia": 8,
                     # GPT-small @ seq 1024: 16 seqs/chip
-                    "gpt_small_lm": 16}.get(preset, 64)
+                    "gpt_small_lm": 16,
+                    "imagenet_vit_s16": 256}.get(preset, 64)
         cfg.train.global_batch = per_chip
     apply_overrides(cfg, ["data.prefetch=0", "data.synthetic=true"])
     # One batch is all the bench consumes — don't materialize the default

@@ -168,6 +168,26 @@ def _cmd_train(args) -> int:
     return 0
 
 
+def _cmd_eval(args) -> int:
+    cfg = apply_overrides(get_preset(args.preset), args.overrides)
+    if args.accelerator:
+        cfg.stack.accelerator = args.accelerator
+    if cfg.stack.accelerator == "cpu":
+        from ..runtime.platform import force_cpu_platform
+
+        force_cpu_platform()
+    from ..train.run import run_eval
+
+    try:
+        metrics = run_eval(cfg, step=args.step)
+    except FileNotFoundError as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps({k: round(v, 6) if isinstance(v, float) else v
+                      for k, v in metrics.items()}))
+    return 0
+
+
 def _train_on_stack(args, cfg: ExperimentConfig) -> int:
     """Multi-host path: fan the worker module to every stack host (L2)."""
     from ..launch import JobLauncher, LocalTransport, SshTransport
@@ -516,6 +536,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="config overrides, e.g. train.global_batch=256")
     _add_stack_args(tr)
     tr.set_defaults(fn=_cmd_train)
+
+    ev = sub.add_parser(
+        "eval",
+        help="evaluate a trained checkpoint (full weighted eval + the "
+             "workload's acceptance metric) without training")
+    ev.add_argument("--preset", required=True)
+    ev.add_argument("--accelerator", default="", choices=["", "tpu", "cpu"])
+    ev.add_argument("--step", type=int, default=0,
+                    help="committed checkpoint step (0 = latest)")
+    ev.add_argument("overrides", nargs="*",
+                    help="config overrides — at least the workdir the "
+                         "training run used")
+    ev.set_defaults(fn=_cmd_eval)
 
     # introspection ----------------------------------------------------------
     pr = sub.add_parser("presets", help="list training presets")

@@ -23,7 +23,7 @@ def register_model(name: str):
 def build_model(name: str, num_classes: int, dtype, **kwargs):
     # Import model modules lazily so `import deeplearning_cfn_tpu` stays cheap.
     from . import resnet, bert, transformer_nmt, maskrcnn, pipelined, \
-        bert_long, lm  # noqa: F401
+        bert_long, lm, vit  # noqa: F401
 
     if name not in _REGISTRY:
         raise KeyError(f"unknown model {name!r}; available: {sorted(_REGISTRY)}")
@@ -32,6 +32,6 @@ def build_model(name: str, num_classes: int, dtype, **kwargs):
 
 def list_models():
     from . import resnet, bert, transformer_nmt, maskrcnn, pipelined, \
-        bert_long, lm  # noqa: F401
+        bert_long, lm, vit  # noqa: F401
 
     return sorted(_REGISTRY)

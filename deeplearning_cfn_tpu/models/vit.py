@@ -1,0 +1,103 @@
+"""Vision Transformer (ViT) image classifiers.
+
+Beyond the reference's workload list (its vision stack is conv-era:
+ResNet/Mask R-CNN), included for the same reason the LM family is: the
+framework's transformer machinery (flash attention, TP PARAM_RULES, MoE
+FFNs via the shared TransformerLayer) should serve vision too, and ViT is
+the standard modern ImageNet trunk. TPU-first details:
+
+- Patch embedding is a P×P/stride-P conv — one big matmul-shaped op the
+  MXU eats directly (no im2col).
+- Global-average-pool head (no CLS token): one fewer sequence position,
+  no special-casing anywhere, accuracy-neutral at this scale.
+- Pre-LN blocks reused from models/transformer.py, so ViT picks up the
+  fused/flash attention path and the tensor-parallel PARAM_RULES for
+  free.
+
+Plugs into ClassificationTask via the model registry — the ImageNet
+pipeline, LARS/AdamW recipes, eval (top-1/top-5), and bench all apply
+unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from . import register_model
+from .transformer import TRANSFORMER_PARAM_RULES, TransformerLayer
+
+Dtype = Any
+
+PARAM_RULES = TRANSFORMER_PARAM_RULES
+
+
+class VisionTransformer(nn.Module):
+    num_classes: int
+    patch_size: int = 16
+    hidden_size: int = 384
+    num_layers: int = 12
+    num_heads: int = 6
+    mlp_dim: int = 1536
+    dtype: Dtype = jnp.bfloat16
+    dropout_rate: float = 0.0
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b, h, w, _ = x.shape
+        p = self.patch_size
+        if h % p or w % p:
+            raise ValueError(
+                f"image {h}x{w} not divisible by patch size {p}")
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.hidden_size, (p, p), strides=(p, p),
+                    padding="VALID", dtype=self.dtype,
+                    kernel_init=nn.initializers.variance_scaling(
+                        1.0, "fan_in", "truncated_normal"),
+                    name="patch_embed")(x)
+        x = x.reshape(b, -1, self.hidden_size)  # [B, N, D]
+        n = x.shape[1]
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (n, self.hidden_size), jnp.float32)
+        x = x + pos[None].astype(self.dtype)
+        if self.dropout_rate > 0:
+            x = nn.Dropout(self.dropout_rate)(x, deterministic=not train)
+        for i in range(self.num_layers):
+            x = TransformerLayer(
+                self.num_heads, self.mlp_dim, dtype=self.dtype,
+                dropout_rate=self.dropout_rate, prenorm=True,
+                attention_impl=self.attention_impl,
+                name=f"layer_{i}")(x, deterministic=not train)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="final_norm")(x)
+        x = jnp.mean(x, axis=1)  # GAP head
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     kernel_init=nn.initializers.zeros_init(),
+                     name="head")(x)
+        return x.astype(jnp.float32)
+
+
+@register_model("vit_s16")
+def vit_s16(num_classes: int = 1000, dtype=jnp.bfloat16, **kw):
+    # ViT-Small/16 (22M params) — the standard from-scratch ImageNet ViT.
+    return VisionTransformer(num_classes=num_classes, patch_size=16,
+                             hidden_size=384, num_layers=12, num_heads=6,
+                             mlp_dim=1536, dtype=dtype, **kw)
+
+
+@register_model("vit_b16")
+def vit_b16(num_classes: int = 1000, dtype=jnp.bfloat16, **kw):
+    return VisionTransformer(num_classes=num_classes, patch_size=16,
+                             hidden_size=768, num_layers=12, num_heads=12,
+                             mlp_dim=3072, dtype=dtype, **kw)
+
+
+@register_model("vit_tiny")
+def vit_tiny(num_classes: int = 10, dtype=jnp.float32, **kw):
+    kw.setdefault("patch_size", 4)
+    return VisionTransformer(num_classes=num_classes,
+                             hidden_size=64, num_layers=2, num_heads=4,
+                             mlp_dim=128, dtype=dtype, **kw)

@@ -230,6 +230,30 @@ def _maskrcnn() -> ExperimentConfig:
     )
 
 
+@register_preset("imagenet_vit_s16")
+def _vit_s16() -> ExperimentConfig:
+    """ViT-Small/16 ImageNet from scratch — beyond the reference's
+    conv-era vision stack (models/vit.py explains the inclusion). Recipe:
+    the DeiT-style from-scratch setup — AdamW(0.9, 0.999) wd 0.05, cosine
+    with warmup, dropout 0.1, 300-epoch-equivalent step budget; GAP head.
+    """
+    return ExperimentConfig(
+        model=ModelConfig(
+            name="vit_s16", num_classes=1000,
+            kwargs=dict(dropout_rate=0.1),
+        ),
+        data=DataConfig(name="imagenet", image_size=224),
+        train=TrainConfig(global_batch=1024, epochs=300, dtype="bfloat16",
+                          label_smoothing=0.1, shard_opt_state=True),
+        optimizer=OptimizerConfig(name="adamw", weight_decay=0.05,
+                                  grad_clip_norm=1.0),
+        schedule=ScheduleConfig(name="cosine", base_lr=1e-3,
+                                warmup_epochs=5.0),
+        mesh=MeshConfig(data=-1),
+        stack=StackConfig(slice_type="v5p-64"),
+    )
+
+
 @register_preset("gpt_small_lm")
 def _gpt_small() -> ExperimentConfig:
     """GPT-2-small decoder-only LM pretraining — beyond the reference's

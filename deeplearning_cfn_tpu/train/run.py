@@ -26,6 +26,92 @@ from .task import build_task
 from .trainer import Trainer
 
 
+def _workdir_and_ckpt_dir(cfg: ExperimentConfig):
+    """The one definition of the experiment's on-disk layout."""
+    workdir = os.path.join(cfg.workdir, cfg.preset or cfg.model.name)
+    ckpt_dir = cfg.checkpoint.directory or os.path.join(workdir, "ckpt")
+    return workdir, ckpt_dir
+
+
+def _build_eval_pipe(cfg: ExperimentConfig, task, mesh):
+    """Eval pipeline honoring the task's exact-eval contract: tasks that
+    weight metrics by eval_mask get the exact full eval set (padded
+    tail); others keep the drop-remainder contract."""
+    eval_batch = cfg.train.eval_batch or cfg.train.global_batch
+    exact_eval = getattr(task, "exact_eval", False)
+    return build_pipeline(cfg.data, local_batch_size(eval_batch, mesh),
+                          cfg.model.num_classes, seed=cfg.train.seed,
+                          train=False, drop_remainder=not exact_eval)
+
+
+def _build_trainer(cfg: ExperimentConfig, task, tx, mesh) -> Trainer:
+    return Trainer(cfg, task.loss_fn, tx, mesh=mesh,
+                   spatial_dim=getattr(task, "spatial_dim", None),
+                   spatial_keys=getattr(task, "spatial_keys", None),
+                   eval_derived=getattr(task, "eval_derived", None))
+
+
+def _final_eval(cfg, task, trainer, state, eval_pipe) -> Dict[str, float]:
+    """Weighted full-set eval + the workload's own acceptance metric
+    (tasks that define final_eval run the reference's yardstick: BLEU
+    for NMT, COCO mAP for detection)."""
+    final = trainer.evaluate(state, eval_pipe.one_epoch())
+    task_final_eval = getattr(task, "final_eval", None)
+    if task_final_eval is not None and cfg.eval.enabled:
+        final.update(task_final_eval(
+            state, lambda: eval_pipe.one_epoch(), trainer))
+    return final
+
+
+def run_eval(
+    cfg: ExperimentConfig,
+    step: int = 0,
+    mesh=None,
+) -> Dict[str, float]:
+    """Evaluate a trained checkpoint — no training step is taken.
+
+    Restores the latest committed checkpoint under the experiment's
+    checkpoint dir (or the exact ``step``), runs the weighted full-set
+    eval plus the task's own acceptance metric (``final_eval``: BLEU,
+    COCO mAP), and returns the metrics. The standalone judging flow the
+    reference's example scripts offered via their ``--eval-only``-style
+    entry points.
+    """
+    from ..ckpt import latest_checkpoint
+
+    _, ckpt_dir = _workdir_and_ckpt_dir(cfg)
+    # Fail on the common error (wrong workdir/preset) in milliseconds,
+    # before any model or data-pipeline construction.
+    if latest_checkpoint(ckpt_dir) is None:
+        raise FileNotFoundError(
+            f"no committed checkpoint to evaluate in {ckpt_dir}")
+    mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
+    task = build_task(cfg, mesh=mesh)
+    eval_pipe = _build_eval_pipe(cfg, task, mesh)
+    # The optimizer is never stepped; a schedule-free SGD keeps the state
+    # tree minimal (restore targets only the keys the template carries,
+    # so the checkpoint's real optimizer slots are simply not read).
+    import optax
+
+    tx = optax.sgd(0.0)
+    state = create_train_state(
+        jax.random.PRNGKey(cfg.train.seed), task.init, tx, mesh,
+        param_rules=getattr(task, "param_rules", ()),
+        ema=cfg.train.ema_decay > 0,
+        shard_opt_state=False,
+    )
+    manager = CheckpointManager(ckpt_dir)
+    restored, at_step = manager.restore_or_none(state, step=step)
+    state = restored
+    trainer = _build_trainer(cfg, task, tx, mesh)
+    if jax.process_index() == 0:
+        print(f"[dlcfn-tpu] evaluating checkpoint step {at_step} "
+              f"({describe(mesh)})")
+    metrics = _final_eval(cfg, task, trainer, state, eval_pipe)
+    metrics["checkpoint_step"] = int(at_step)
+    return metrics
+
+
 def run_experiment(
     cfg: ExperimentConfig,
     max_steps: Optional[int] = None,
@@ -39,13 +125,7 @@ def run_experiment(
     train_pipe = build_pipeline(cfg.data, local_batch,
                                 cfg.model.num_classes, seed=cfg.train.seed,
                                 train=True)
-    eval_batch = cfg.train.eval_batch or cfg.train.global_batch
-    # Tasks that weight metrics by eval_mask get the exact full eval set
-    # (padded tail); others keep the drop-remainder contract.
-    exact_eval = getattr(task, "exact_eval", False)
-    eval_pipe = build_pipeline(cfg.data, local_batch_size(eval_batch, mesh),
-                               cfg.model.num_classes, seed=cfg.train.seed,
-                               train=False, drop_remainder=not exact_eval)
+    eval_pipe = _build_eval_pipe(cfg, task, mesh)
 
     steps_per_epoch = max(train_pipe.steps_per_epoch, 1)
     total_steps = (cfg.train.steps if cfg.train.steps > 0
@@ -66,8 +146,7 @@ def run_experiment(
         shard_opt_state=cfg.train.shard_opt_state,
     )
 
-    workdir = os.path.join(cfg.workdir, cfg.preset or cfg.model.name)
-    ckpt_dir = cfg.checkpoint.directory or os.path.join(workdir, "ckpt")
+    workdir, ckpt_dir = _workdir_and_ckpt_dir(cfg)
     ckpt_every = cfg.checkpoint.every_steps or steps_per_epoch
     manager = CheckpointManager(ckpt_dir, every_steps=ckpt_every,
                                 keep=cfg.checkpoint.keep,
@@ -79,10 +158,7 @@ def run_experiment(
             if jax.process_index() == 0:
                 print(f"[dlcfn-tpu] resumed from step {at_step}")
 
-    trainer = Trainer(cfg, task.loss_fn, tx, mesh=mesh,
-                      spatial_dim=getattr(task, "spatial_dim", None),
-                      spatial_keys=getattr(task, "spatial_keys", None),
-                      eval_derived=getattr(task, "eval_derived", None))
+    trainer = _build_trainer(cfg, task, tx, mesh)
     metrics_path = os.path.join(workdir, "metrics.jsonl")
     writer = MetricsWriter(metrics_path)
     if jax.process_index() == 0:
@@ -113,14 +189,7 @@ def run_experiment(
     manager.save(int(state.step), state, force=True)
     manager.wait()
 
-    final = trainer.evaluate(state, eval_pipe.one_epoch())
-    # Workload acceptance metrics beyond the loss-based eval: tasks that
-    # define final_eval run the reference's own yardstick (BLEU for NMT,
-    # COCO mAP for detection) over the eval set once, at the end.
-    task_final_eval = getattr(task, "final_eval", None)
-    if task_final_eval is not None and cfg.eval.enabled:
-        final.update(task_final_eval(
-            state, lambda: eval_pipe.one_epoch(), trainer))
+    final = _final_eval(cfg, task, trainer, state, eval_pipe)
     writer.write({"step": int(state.step),
                   **{f"final_eval_{k}": v for k, v in final.items()}})
     writer.close()

@@ -93,6 +93,14 @@ class ClassificationTask:
         self.model = build_model(
             cfg.model.name, cfg.model.num_classes, dtype, **cfg.model.kwargs
         )
+        # A model family owns its tensor-parallel rules: read PARAM_RULES
+        # from the model's defining module (vit exports the transformer
+        # rules; resnet exports none). Name-prefix checks here would
+        # silently drop TP for any new transformer classifier.
+        import sys
+
+        self.param_rules = getattr(
+            sys.modules[type(self.model).__module__], "PARAM_RULES", ())
         self.remat = cfg.train.remat
 
     def init(self, rng: jax.Array):
@@ -100,27 +108,34 @@ class ClassificationTask:
         dummy = jnp.zeros(shape, jnp.float32)
         return self.model.init(rng, dummy, train=False)
 
-    def _forward_train(self, params, batch_stats, images):
+    def _forward_train(self, params, batch_stats, images, rng):
         variables = {"params": params}
+        rngs = {"dropout": rng} if rng is not None else None
         if batch_stats:
             variables["batch_stats"] = batch_stats
-        logits, mutated = self.model.apply(
-            variables, images, train=True, mutable=["batch_stats"]
-        )
-        return logits, mutated.get("batch_stats", batch_stats)
+            logits, mutated = self.model.apply(
+                variables, images, train=True, mutable=["batch_stats"],
+                rngs=rngs,
+            )
+            return logits, mutated.get("batch_stats", batch_stats)
+        # Stats-free models (ViT): still a true train-mode forward —
+        # dropout active, driven by the step rng.
+        return self.model.apply(variables, images, train=True,
+                                rngs=rngs), batch_stats
 
     def loss_fn(self, params: PyTree, batch_stats: PyTree,
                 batch: Dict[str, jnp.ndarray], rng, train: bool
                 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
         has_stats = bool(batch_stats)
-        if train and has_stats:
+        if train:
             fwd = self._forward_train
             if self.remat:
                 # Rematerialize the forward: trade FLOPs for HBM. Wraps the
                 # pure apply, not the Module (Modules aren't callables with
                 # init/apply after jax.checkpoint).
                 fwd = jax.checkpoint(fwd)
-            logits, new_stats = fwd(params, batch_stats, batch["image"])
+            logits, new_stats = fwd(params, batch_stats, batch["image"],
+                                    rng)
         else:
             variables = {"params": params}
             if has_stats:
@@ -443,7 +458,7 @@ def build_task(cfg: ExperimentConfig, mesh=None):
     correct as long as the caller does the same (build_mesh is
     deterministic over jax.devices())."""
     name = cfg.model.name
-    if name.startswith("resnet"):
+    if name.startswith("resnet") or name.startswith("vit"):
         return ClassificationTask(cfg)
     if name.startswith("gpt"):
         return CausalLmTask(cfg, mesh=mesh)

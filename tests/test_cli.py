@@ -117,6 +117,30 @@ def test_stack_resize(tmp_path, capsys):
     assert main(["stack", "delete", "rz", "--state-dir", state_dir]) == 0
 
 
+def test_eval_verb_standalone(tmp_path, capsys):
+    """`eval` re-judges a finished run from its checkpoint: same weighted
+    metrics machinery, no training step."""
+    common = [
+        "--preset", "cifar10_resnet20", "--accelerator", "cpu",
+        f"workdir={tmp_path}", "train.global_batch=32",
+        "data.num_train_examples=64", "data.num_eval_examples=32",
+        "train.eval_batch=32", "schedule.warmup_epochs=0",
+        "checkpoint.async_write=false", "data.prefetch=0",
+    ]
+    assert main(["train", *common, "train.steps=4",
+                 "train.log_every_steps=2"]) == 0
+    capsys.readouterr()
+    assert main(["eval", *common]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert {"loss", "accuracy", "accuracy_top5",
+            "checkpoint_step"} <= set(rec)
+    assert rec["checkpoint_step"] == 4
+
+    # Evaluating a workdir with no checkpoints errors loudly.
+    assert main(["eval", "--preset", "cifar10_resnet20",
+                 "--accelerator", "cpu", f"workdir={tmp_path}/empty"]) == 1
+
+
 def test_ckpt_list_and_rollback_verbs(tmp_path, capsys):
     import jax.numpy as jnp
 
