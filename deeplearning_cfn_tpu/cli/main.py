@@ -188,6 +188,62 @@ def _cmd_eval(args) -> int:
     return 0
 
 
+def _cmd_generate(args) -> int:
+    """Sampling demo for the LM family: byte-level prompt → continuation.
+    Uses the lm_text byte tokenizer contract (data prepare-text): byte
+    values shifted past the 4 reserved special ids."""
+    cfg = apply_overrides(get_preset(args.preset), args.overrides)
+    if args.accelerator:
+        cfg.stack.accelerator = args.accelerator
+    if cfg.stack.accelerator == "cpu":
+        from ..runtime.platform import force_cpu_platform
+
+        force_cpu_platform()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ckpt import CheckpointManager, latest_checkpoint
+    from ..models.decoding import lm_generate
+    from ..train.run import _workdir_and_ckpt_dir
+    from ..train.task import build_task
+
+    _, ckpt_dir = _workdir_and_ckpt_dir(cfg)
+    if latest_checkpoint(ckpt_dir) is None:
+        print(f"[dlcfn-tpu] ERROR: no committed checkpoint in {ckpt_dir}",
+              file=sys.stderr)
+        return 1
+    task = build_task(cfg)
+    if not hasattr(type(task.model), "decode_step"):
+        print(f"[dlcfn-tpu] ERROR: model {cfg.model.name!r} has no "
+              f"decode_step (generate needs the causal-LM family)",
+              file=sys.stderr)
+        return 1
+    variables = task.init(jax.random.PRNGKey(0))
+    manager = CheckpointManager(ckpt_dir)
+    try:
+        restored, at_step = manager.restore_or_none(
+            {"params": variables["params"]}, step=args.step)
+        prompt = jnp.asarray(
+            [[b + 4 for b in args.prompt.encode()]], jnp.int32)
+        out = lm_generate(task.model, restored, prompt,
+                          args.max_new_tokens,
+                          temperature=args.temperature, top_k=args.top_k,
+                          rng=jax.random.PRNGKey(args.seed)
+                          if args.temperature > 0 else None)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    # Clamp both sides: ids 0-3 are specials, ids >= 260 exist whenever
+    # the model's vocab is larger than the byte tokenizer's (the default
+    # gpt_small_lm preset's 32768) — map them to '?' rather than crash.
+    text = bytes(min(max(int(t) - 4, 0), 255) if int(t) < 260 else 0x3F
+                 for t in np.asarray(out[0])).decode(errors="replace")
+    print(f"[dlcfn-tpu] checkpoint step {at_step}:")
+    print(text)
+    return 0
+
+
 def _train_on_stack(args, cfg: ExperimentConfig) -> int:
     """Multi-host path: fan the worker module to every stack host (L2)."""
     from ..launch import JobLauncher, LocalTransport, SshTransport
@@ -549,6 +605,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help="config overrides — at least the workdir the "
                          "training run used")
     ev.set_defaults(fn=_cmd_eval)
+
+    gen = sub.add_parser(
+        "generate",
+        help="generate text from a trained causal-LM checkpoint "
+             "(byte-level prompt in, KV-cached sampling out)")
+    gen.add_argument("--preset", default="gpt_small_lm")
+    gen.add_argument("--accelerator", default="",
+                     choices=["", "tpu", "cpu"])
+    gen.add_argument("--prompt", required=True,
+                     help="prompt text (byte-level tokenized)")
+    gen.add_argument("--max-new-tokens", type=int, default=128)
+    gen.add_argument("--temperature", type=float, default=0.0,
+                     help="0 = greedy")
+    gen.add_argument("--top-k", type=int, default=0)
+    gen.add_argument("--seed", type=int, default=0)
+    gen.add_argument("--step", type=int, default=0,
+                     help="committed checkpoint step (0 = latest)")
+    gen.add_argument("overrides", nargs="*",
+                     help="config overrides — at least the workdir the "
+                          "training run used")
+    gen.set_defaults(fn=_cmd_generate)
 
     # introspection ----------------------------------------------------------
     pr = sub.add_parser("presets", help="list training presets")

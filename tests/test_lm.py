@@ -197,6 +197,46 @@ def test_lm_generate_sampling_is_seeded_and_in_vocab():
         lm_generate(model, variables, prompt, 15)
 
 
+def test_generate_verb_end_to_end(tmp_path, capsys):
+    """train (byte-level corpus) → `generate` verb continues the learned
+    text from a prompt — the LM family's full user loop via the CLI."""
+    from deeplearning_cfn_tpu.cli.main import main
+    from deeplearning_cfn_tpu.data.text import prepare_lm_text
+
+    src = tmp_path / "c.txt"
+    src.write_bytes(b"abcdefgh" * 600)
+    tok = str(tmp_path / "tok")
+    prepare_lm_text(str(src), tok, seq_len=15)
+    common = [
+        "--preset", "gpt_small_lm", "--accelerator", "cpu",
+        f"workdir={tmp_path}", "model.name=gpt_tiny",
+        'model.kwargs={"vocab_size": 260, "max_len": 16}',
+        "data.name=lm_text", f"data.data_dir={tok}",
+        "data.synthetic=false", "data.vocab_size=260", "data.seq_len=15",
+        "train.global_batch=16", "train.dtype=float32",
+        "train.eval_batch=16", "schedule.name=constant",
+        "schedule.base_lr=3e-3", "schedule.warmup_steps=5",
+        "train.shard_opt_state=false", "checkpoint.async_write=false",
+        "data.prefetch=0",
+    ]
+    assert main(["train", *common, "train.steps=40",
+                 "train.log_every_steps=10"]) == 0
+    capsys.readouterr()
+    assert main(["generate", *common, "--prompt", "abcd",
+                 "--max-new-tokens", "8"]) == 0
+    out = capsys.readouterr().out
+    # The corpus is the 8-cycle "abcdefgh": a model at ~100% token
+    # accuracy must continue it exactly.
+    assert "abcdefghabcd" in out, out
+    # Misuse exits 1 with an error, not a traceback: wrong preset/workdir
+    # (no checkpoint), and an explicit step that was never committed.
+    assert main(["generate", "--preset", "cifar10_resnet20",
+                 "--accelerator", "cpu", f"workdir={tmp_path}",
+                 "--prompt", "x"]) == 1
+    assert main(["generate", *common, "--prompt", "abcd",
+                 "--step", "999"]) == 1
+
+
 def test_lm_moe_trains_and_shards_experts(tmp_workdir, devices):
     """gpt with num_experts: MoE aux losses thread into the objective and
     expert weights shard over the 'expert' mesh axis (the GShard
