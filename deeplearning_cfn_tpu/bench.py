@@ -39,6 +39,7 @@ _UNITS = {
     "bert_pipelined_wikipedia": "sequences/sec/chip",
     "bert_long_wikipedia": "sequences/sec/chip",
     "gpt_small_lm": "sequences/sec/chip",
+    "gpt_long_lm": "sequences/sec/chip",
     "imagenet_vit_s16": "images/sec/chip",
 }
 
@@ -140,8 +141,15 @@ def run_bench(
                     "bert_long_wikipedia": 8,
                     # GPT-small @ seq 1024: 16 seqs/chip
                     "gpt_small_lm": 16,
+                    # seq-16384: 1 seq/chip (dense fallback on one chip)
+                    "gpt_long_lm": 1,
                     "imagenet_vit_s16": 256}.get(preset, 64)
         cfg.train.global_batch = per_chip
+        # Single-chip step-time probe: accumulation is a memory/global-
+        # batch device-scaling tool, and the tiny per-chip batches above
+        # need not divide a preset's accum factor (gpt_long_lm: batch 1
+        # vs accum 2 would be rejected by the Trainer).
+        cfg.train.grad_accum_steps = 1
     apply_overrides(cfg, ["data.prefetch=0", "data.synthetic=true"])
     # One batch is all the bench consumes — don't materialize the default
     # multi-GB synthetic dataset (8192×224² ImageNet ≈ 5 GB host RAM).

@@ -234,10 +234,11 @@ def _cmd_generate(args) -> int:
     except (FileNotFoundError, ValueError) as e:
         print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
         return 1
-    # Clamp both sides: ids 0-3 are specials, ids >= 260 exist whenever
-    # the model's vocab is larger than the byte tokenizer's (the default
-    # gpt_small_lm preset's 32768) — map them to '?' rather than crash.
-    text = bytes(min(max(int(t) - 4, 0), 255) if int(t) < 260 else 0x3F
+    # Out-of-byte-range ids print as '?': ids 0-3 are specials, ids
+    # >= 260 exist whenever the model's vocab is larger than the byte
+    # tokenizer's (the default gpt_small_lm preset's 32768) — neither
+    # may crash the decoder.
+    text = bytes(int(t) - 4 if 4 <= int(t) < 260 else 0x3F
                  for t in np.asarray(out[0])).decode(errors="replace")
     print(f"[dlcfn-tpu] checkpoint step {at_step}:")
     print(text)

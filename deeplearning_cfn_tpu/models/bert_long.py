@@ -60,16 +60,21 @@ class SeqParallelAttention(MultiHeadAttention):
     batch_axes: Any = "data"
 
     def core_attention(self, q, k, v, bias, causal):
-        assert bias is None and not causal, \
-            "sequence-parallel attention is the packed, non-causal contract"
+        # Packed-sequence contract: no padding bias (it would have to be
+        # resharded alongside K/V blocks). Causal IS supported — the ring
+        # masks with global block offsets, Ulysses holds each head group's
+        # full sequence — which is what makes gpt_long (models/lm.py)
+        # possible on the same attention core.
+        assert bias is None, \
+            "sequence-parallel attention is the packed (no-bias) contract"
         seq_ways = (self.mesh.shape.get("seq", 1)
                     if self.mesh is not None else 1)
         if seq_ways > 1 and not self.is_initializing():
             fn = {"ring": ring_attention_sharded,
                   "ulysses": ulysses_attention_sharded}[self.seq_impl]
-            return fn(q, k, v, self.mesh, axis_name="seq",
+            return fn(q, k, v, self.mesh, axis_name="seq", causal=causal,
                       batch_axis=self.batch_axes)
-        return super().core_attention(q, k, v, None, False)
+        return super().core_attention(q, k, v, None, causal)
 
 
 class LongBert(nn.Module):

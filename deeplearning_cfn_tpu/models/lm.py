@@ -128,6 +128,87 @@ class TransformerCausalLm(nn.Module):
         return self.token.attend(x.astype(jnp.float32))
 
 
+class LongCausalLm(nn.Module):
+    """Long-context causal LM: the GPT trunk with sequence-parallel
+    attention over the 'seq' mesh axis (ring or Ulysses — both causal-
+    exact; bert_long.SeqParallelAttention). Pre-LN blocks, tied logits,
+    same CausalLmTask contract as TransformerCausalLm. Exact, so
+    (data=k, seq=n) reproduces (data=k*n) numerics — test-pinned like
+    bert_long."""
+
+    vocab_size: int
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 4096
+    dtype: Dtype = jnp.bfloat16
+    dropout_rate: float = 0.0
+    seq_impl: str = "ring"
+    mesh: Any = None
+    batch_axes: Any = "data"
+
+    def _constrain(self, x):
+        if self.mesh is None or self.mesh.shape.get("seq", 1) <= 1 \
+                or self.is_initializing():
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P(self.batch_axes, "seq", None)))
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        from .bert_long import SeqParallelAttention
+        from .transformer import Mlp
+
+        deterministic = not train
+        token = nn.Embed(self.vocab_size, self.hidden_size,
+                         param_dtype=jnp.float32,
+                         embedding_init=nn.initializers.normal(0.02),
+                         name="token")
+        position = self.param(
+            "position", nn.initializers.normal(0.02),
+            (self.max_len, self.hidden_size), jnp.float32)
+        x = token(tokens) + position[None, :tokens.shape[1], :]
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="embed_norm")(x.astype(self.dtype))
+        ln = lambda name: nn.LayerNorm(
+            dtype=self.dtype, param_dtype=jnp.float32, name=name)
+        for i in range(self.num_layers):
+            x = self._constrain(x)
+            attn = SeqParallelAttention(
+                num_heads=self.num_heads, dtype=self.dtype,
+                dropout_rate=self.dropout_rate, seq_impl=self.seq_impl,
+                mesh=self.mesh, batch_axes=self.batch_axes,
+                name=f"layer_{i}_self_attn")
+            # Pre-LN residual blocks (the GPT layout).
+            x = x + attn(ln(f"layer_{i}_self_attn_norm")(x), causal=True,
+                         deterministic=deterministic)
+            x = self._constrain(x)
+            x = x + Mlp(self.mlp_dim, self.dtype, self.dropout_rate,
+                        name=f"layer_{i}_mlp")(
+                ln(f"layer_{i}_mlp_norm")(x), deterministic=deterministic)
+        x = self._constrain(x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="final_norm")(x)
+        return token.attend(x.astype(jnp.float32))
+
+
+@register_model("gpt_long")
+def gpt_long(num_classes: int = 0, dtype=jnp.bfloat16, *,
+             vocab_size: int = 32768, hidden_size: int = 768,
+             num_layers: int = 12, num_heads: int = 12,
+             mlp_dim: int = 3072, max_len: int = 4096,
+             dropout_rate: float = 0.0, seq_impl: str = "ring",
+             mesh=None, batch_axes="data"):
+    return LongCausalLm(
+        vocab_size=vocab_size, hidden_size=hidden_size,
+        num_layers=num_layers, num_heads=num_heads, mlp_dim=mlp_dim,
+        max_len=max_len, dtype=dtype, dropout_rate=dropout_rate,
+        seq_impl=seq_impl, mesh=mesh, batch_axes=batch_axes)
+
+
 @register_model("gpt_small")
 def gpt_small(num_classes: int = 0, dtype=jnp.bfloat16, *,
               vocab_size: int = 32768, max_len: int = 1024, **kw):

@@ -207,6 +207,35 @@ def _bert_long() -> ExperimentConfig:
     )
 
 
+@register_preset("gpt_long_lm")
+def _gpt_long() -> ExperimentConfig:
+    """Long-context causal LM: GPT trunk at sequence 16384 with ring
+    attention over a 'seq' mesh axis (models/lm.py LongCausalLm) — the
+    causal long-context flagship, proving the sequence-parallel ops'
+    causal masking at scale. Same recipe family as gpt_small_lm; packed
+    sequences. seq_impl=ulysses needs heads % seq ways == 0 — with this
+    preset's 12 heads that means also setting mesh.seq to 4 or 6 (the
+    default 8 does not divide 12)."""
+    return ExperimentConfig(
+        model=ModelConfig(
+            name="gpt_long",
+            kwargs=dict(
+                hidden_size=768, num_layers=12, num_heads=12, mlp_dim=3072,
+                max_len=16384, seq_impl="ring",
+            ),
+        ),
+        data=DataConfig(name="lm_text", seq_len=16384, vocab_size=32768),
+        train=TrainConfig(global_batch=64, steps=100_000, dtype="bfloat16",
+                          shard_opt_state=True, grad_accum_steps=2),
+        optimizer=OptimizerConfig(name="adamw", b1=0.9, b2=0.95,
+                                  weight_decay=0.1, grad_clip_norm=1.0),
+        schedule=ScheduleConfig(name="cosine", base_lr=3e-4,
+                                warmup_steps=2000),
+        mesh=MeshConfig(data=-1, seq=8),
+        stack=StackConfig(slice_type="v5p-64"),
+    )
+
+
 @register_preset("maskrcnn_coco")
 def _maskrcnn() -> ExperimentConfig:
     """Mask R-CNN COCO — the one beyond-DP config: pjit data+spatial shard

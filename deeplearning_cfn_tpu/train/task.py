@@ -390,6 +390,15 @@ class CausalLmTask:
         kwargs = dict(cfg.model.kwargs)
         kwargs.setdefault("vocab_size", cfg.data.vocab_size)
         kwargs.setdefault("max_len", max(cfg.data.seq_len, 128))
+        if cfg.model.name == "gpt_long":
+            # Sequence-parallel trunk: needs the trainer's mesh and the
+            # batch-dim spec it will feed (same contract as bert_long).
+            from ..parallel.mesh import build_mesh
+            from ..parallel.sharding import batch_sharding
+
+            mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
+            kwargs.setdefault("mesh", mesh)
+            kwargs.setdefault("batch_axes", batch_sharding(mesh, 1).spec[0])
         self.param_rules = PARAM_RULES
         self.model = build_model(cfg.model.name, 0, dtype, **kwargs)
         self.remat = cfg.train.remat
