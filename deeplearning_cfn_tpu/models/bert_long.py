@@ -77,6 +77,18 @@ class SeqParallelAttention(MultiHeadAttention):
         return super().core_attention(q, k, v, None, causal)
 
 
+def constrain_seq_sharding(module: nn.Module, x, mesh, batch_axes):
+    """Keep activations [batch(...), seq('seq'), feature] sharded so the
+    elementwise layers run distributed and only attention communicates.
+    Shared by every sequence-parallel trunk (LongBert, lm.LongCausalLm) —
+    one definition of the seq-gating rule."""
+    if mesh is None or mesh.shape.get("seq", 1) <= 1 \
+            or module.is_initializing():
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(batch_axes, "seq", None)))
+
+
 class LongBert(nn.Module):
     """BertPretrain's contract with sequence-parallel attention."""
 
@@ -94,13 +106,7 @@ class LongBert(nn.Module):
     batch_axes: Any = "data"
 
     def _constrain(self, x):
-        """Keep activations [batch('data'...), seq('seq'), feature] so the
-        elementwise layers run sharded and only attention communicates."""
-        if self.mesh is None or self.mesh.shape.get("seq", 1) <= 1 \
-                or self.is_initializing():
-            return x
-        return jax.lax.with_sharding_constraint(
-            x, NamedSharding(self.mesh, P(self.batch_axes, "seq", None)))
+        return constrain_seq_sharding(self, x, self.mesh, self.batch_axes)
 
     @nn.compact
     def __call__(self, input_ids, input_mask, segment_ids, mlm_positions,

@@ -149,13 +149,9 @@ class LongCausalLm(nn.Module):
     batch_axes: Any = "data"
 
     def _constrain(self, x):
-        if self.mesh is None or self.mesh.shape.get("seq", 1) <= 1 \
-                or self.is_initializing():
-            return x
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .bert_long import constrain_seq_sharding
 
-        return jax.lax.with_sharding_constraint(
-            x, NamedSharding(self.mesh, P(self.batch_axes, "seq", None)))
+        return constrain_seq_sharding(self, x, self.mesh, self.batch_axes)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -173,6 +169,11 @@ class LongCausalLm(nn.Module):
         x = token(tokens) + position[None, :tokens.shape[1], :]
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="embed_norm")(x.astype(self.dtype))
+        if self.dropout_rate > 0:
+            # Post-embedding dropout, matching TransformerCausalLm._embed
+            # (same trunk contract → same regularization points).
+            x = nn.Dropout(self.dropout_rate)(
+                x, deterministic=deterministic)
         ln = lambda name: nn.LayerNorm(
             dtype=self.dtype, param_dtype=jnp.float32, name=name)
         for i in range(self.num_layers):

@@ -43,7 +43,7 @@ stage ops_detection    1500 python -m deeplearning_cfn_tpu.cli bench \
 # 5. Per-preset step benches not covered above.
 for p in bert_base_wikipedia transformer_nmt_wmt maskrcnn_coco \
          bert_moe_wikipedia bert_long_wikipedia gpt_small_lm \
-         imagenet_vit_s16; do
+         gpt_long_lm imagenet_vit_s16; do
   stage "bench_$p"      700 python -m deeplearning_cfn_tpu.cli bench \
       --preset "$p" --steps 20
 done
