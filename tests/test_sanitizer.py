@@ -85,6 +85,37 @@ def test_nmt_smoke_under_debug_nans(tmp_workdir, devices):
 
 
 @pytest.mark.sanitizer
+def test_lm_smoke_under_debug_nans(tmp_workdir, devices):
+    cfg = _smoke_cfg(tmp_workdir, "gpt_small_lm")
+    apply_overrides(cfg, [
+        "model.name=gpt_tiny",
+        'model.kwargs={"vocab_size": 32, "max_len": 16}',
+        "data.seq_len=16", "data.vocab_size=32",
+        "data.num_train_examples=64", "data.num_eval_examples=16",
+        "train.shard_opt_state=false",
+    ])
+    with strict_numerics():
+        final = run_experiment(cfg)
+    assert np.isfinite(final["loss"])
+    assert np.isfinite(final["perplexity"])
+
+
+@pytest.mark.sanitizer
+def test_vit_smoke_under_debug_nans(tmp_workdir, devices):
+    cfg = _smoke_cfg(tmp_workdir, "imagenet_vit_s16")
+    apply_overrides(cfg, [
+        "model.name=vit_tiny", "model.num_classes=10",
+        'model.kwargs={"dropout_rate": 0.1}',
+        "data.name=cifar10", "data.image_size=32",
+        "data.num_train_examples=64", "data.num_eval_examples=16",
+        "train.shard_opt_state=false",
+    ])
+    with strict_numerics():
+        final = run_experiment(cfg)
+    assert np.isfinite(final["loss"])
+
+
+@pytest.mark.sanitizer
 def test_debug_nans_actually_fires(devices):
     """The tier is only a net if the flag really aborts on NaN — prove the
     config plumbing works by tripping it on purpose."""
