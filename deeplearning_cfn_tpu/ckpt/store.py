@@ -26,7 +26,7 @@ import io
 import os
 import shutil
 import threading
-from typing import Dict, List, Optional, Union
+from typing import Dict, List, Union
 
 import numpy as np
 

@@ -14,7 +14,6 @@ one node.
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import json
 import os
 import sys

@@ -13,7 +13,7 @@ import os
 import pickle
 import queue
 import threading
-from typing import Callable, Dict, Iterator, Optional, Tuple
+from typing import Callable, Dict, Iterator, Optional
 
 import jax
 import numpy as np

@@ -13,7 +13,7 @@ Scores are in [0, 1]; multiply by 100 for the conventional reporting scale.
 from __future__ import annotations
 
 from collections import Counter
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Sequence
 
 import numpy as np
 

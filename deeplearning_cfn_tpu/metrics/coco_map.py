@@ -17,7 +17,7 @@ Boxes are [y0, x0, y1, x1] pixels; class 0 means invalid/padding.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 

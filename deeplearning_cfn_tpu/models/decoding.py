@@ -20,7 +20,6 @@ Special ids follow data/text.py: 0=[PAD], 1=[BOS], 2=[EOS].
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Tuple
 
 import jax

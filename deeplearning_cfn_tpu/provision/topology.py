@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import re
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 # chips per host by generation. v4/v5p hosts expose 4 chips; v5e/v6e hosts 8
 # (their inference-oriented boards); v2/v3 boards had 4 chips (8 cores).

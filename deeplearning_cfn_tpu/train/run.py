@@ -13,7 +13,6 @@ import os
 from typing import Dict, Optional
 
 import jax
-import numpy as np
 
 from ..ckpt import CheckpointManager
 from ..config import ExperimentConfig
