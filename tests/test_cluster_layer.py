@@ -233,3 +233,99 @@ def test_two_process_rendezvous(tmp_path):
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out}"
         assert "RENDEZVOUS_OK" in out
+
+
+# -- GCP provisioner (offline: gcloud invocations pinned, not run) ----------
+
+
+class _FakeGcloud:
+    """Capture GcpProvisioner._run invocations and script its outputs."""
+
+    def __init__(self, outputs=()):
+        self.calls = []
+        self.outputs = list(outputs)
+
+    def __call__(self, *args):
+        self.calls.append(args)
+        return self.outputs.pop(0) if self.outputs else "{}"
+
+
+def _gcp(monkeypatch, outputs=()):
+    from deeplearning_cfn_tpu.provision.provisioner import GcpProvisioner
+
+    monkeypatch.setattr("shutil.which", lambda name: "/usr/bin/gcloud")
+    prov = GcpProvisioner()
+    fake = _FakeGcloud(outputs)
+    prov._run = fake
+    return prov, fake
+
+
+def test_gcp_create_command_line(monkeypatch):
+    """The create call must carry every config knob — this is the CFN
+    template-parameters contract, TPU-shaped."""
+    prov, fake = _gcp(monkeypatch)
+    cfg = StackConfig(name="prod", slice_type="v5p-16", zone="us-east5-a",
+                      project="my-proj", runtime_version="tpu-vm-custom",
+                      preemptible=True, provisioner="gcp")
+    state = prov.create(cfg)
+    (args,) = fake.calls
+    assert args[:5] == ("compute", "tpus", "tpu-vm", "create", "prod")
+    assert "--zone=us-east5-a" in args
+    assert "--version=tpu-vm-custom" in args
+    assert "--project=my-proj" in args
+    assert "--preemptible" in args
+    assert "--async" in args
+    assert any(a.startswith("--accelerator-type=") for a in args)
+    assert state.status == StackStatus.CREATE_IN_PROGRESS
+    assert len(state.hosts) == 4  # v5p-16 = 4 hosts
+
+
+def test_gcp_refresh_parses_describe(monkeypatch):
+    import json as _json
+
+    desc = _json.dumps({
+        "state": "READY",
+        "networkEndpoints": [
+            {"ipAddress": "10.0.0.2",
+             "accessConfig": {"externalIp": "34.1.2.3"}},
+            {"ipAddress": "10.0.0.3", "accessConfig": {}},
+        ],
+    })
+    prov, fake = _gcp(monkeypatch, outputs=[desc])
+    from deeplearning_cfn_tpu.provision import StackState
+
+    state = StackState(name="prod", slice_type="v5p-8", zone="z")
+    state = prov.refresh(state)
+    assert [h.internal_ip for h in state.hosts] == ["10.0.0.2", "10.0.0.3"]
+    assert [h.external_ip for h in state.hosts] == ["34.1.2.3", ""]
+    assert all(h.state == "READY" for h in state.hosts)
+    assert fake.calls[0][:5] == ("compute", "tpus", "tpu-vm", "describe",
+                                 "prod")
+
+
+def test_gcp_delete_command_line(monkeypatch):
+    from deeplearning_cfn_tpu.provision import StackState
+
+    prov, fake = _gcp(monkeypatch)
+    state = StackState(name="prod", slice_type="v5p-8", zone="z",
+                       project="my-proj")
+    prov.delete(state)
+    (args,) = fake.calls
+    assert args[:5] == ("compute", "tpus", "tpu-vm", "delete", "prod")
+    assert "--quiet" in args and "--project=my-proj" in args
+
+
+def test_gcp_run_raises_on_failure(monkeypatch):
+    from deeplearning_cfn_tpu.provision.provisioner import GcpProvisioner
+
+    monkeypatch.setattr("shutil.which", lambda name: "/usr/bin/gcloud")
+    prov = GcpProvisioner()
+
+    class Proc:
+        returncode = 1
+        stderr = "quota exceeded"
+        stdout = ""
+
+    monkeypatch.setattr("subprocess.run", lambda *a, **k: Proc())
+    with pytest.raises(ProvisionError, match="quota exceeded"):
+        prov._run("compute", "tpus", "list")
