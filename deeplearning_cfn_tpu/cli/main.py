@@ -212,10 +212,18 @@ def _cmd_generate(args) -> int:
         print(f"[dlcfn-tpu] ERROR: no committed checkpoint in {ckpt_dir}",
               file=sys.stderr)
         return 1
+    from ..config import MeshConfig
+    from ..train.task import CausalLmTask
+
+    # generate is a local inference verb: collapse every model axis
+    # (data=-1 absorbs the host's devices) so seq-parallel trunks
+    # (gpt_long) build their dense fallback instead of demanding the
+    # training pod's data×seq layout for a batch-1 prompt.
+    cfg.mesh = MeshConfig(data=-1)
     task = build_task(cfg)
-    if not hasattr(type(task.model), "decode_step"):
-        print(f"[dlcfn-tpu] ERROR: model {cfg.model.name!r} has no "
-              f"decode_step (generate needs the causal-LM family)",
+    if not isinstance(task, CausalLmTask):
+        print(f"[dlcfn-tpu] ERROR: model {cfg.model.name!r} is not a "
+              f"causal LM (generate needs the gpt family)",
               file=sys.stderr)
         return 1
     variables = task.init(jax.random.PRNGKey(0))

@@ -233,6 +233,12 @@ def lm_generate(model, variables, prompt_ids, max_new_tokens: int,
     is greedy argmax; otherwise softmax sampling at that temperature,
     optionally truncated to the ``top_k`` highest logits (``rng``
     required). Static shapes throughout; jit-compatible.
+
+    Models without a ``decode_step`` (gpt_long — its KV cache would have
+    to be sequence-resharded) take the *recompute* drive mode instead:
+    the full causal forward runs over the whole token buffer each step
+    and only position ``t``'s logits are consumed — O(T²) attention but
+    exact, the same fallback contract the NMT searchers offer.
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng")
@@ -246,20 +252,34 @@ def lm_generate(model, variables, prompt_ids, max_new_tokens: int,
         raise ValueError(
             f"prompt ({p}) + max_new_tokens ({max_new_tokens}) = {total} "
             f"exceeds the model's max_len ({max_len})")
-    decode_step = type(model).decode_step
-    cache = model.init(
-        jax.random.PRNGKey(0), jnp.zeros((b, 1), jnp.int32), 0,
-        method=decode_step)["cache"]
+    cached = hasattr(type(model), "decode_step")
+    if cached:
+        decode_step = type(model).decode_step
+        cache = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((b, 1), jnp.int32), 0,
+            method=decode_step)["cache"]
+    else:
+        cache = ()
     tokens = jnp.zeros((b, total), jnp.int32).at[:, :p].set(prompt_ids)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
+    def logits_at(tokens, cache, t):
+        if cached:
+            tok = jax.lax.dynamic_slice(tokens, (0, t), (b, 1))
+            logits, mut = model.apply(
+                {"params": variables["params"], "cache": cache}, tok, t,
+                method=decode_step, mutable=["cache"])
+            return logits[:, 0, :], mut["cache"]
+        # Recompute: causal masking makes position t's logits depend only
+        # on tokens[:, :t+1], so the not-yet-filled tail is inert.
+        full = model.apply({"params": variables["params"]}, tokens,
+                           train=False)
+        return jax.lax.dynamic_slice(
+            full, (0, t, 0), (b, 1, full.shape[-1]))[:, 0, :], cache
+
     def step(carry, t):
         tokens, cache, rng = carry
-        tok = jax.lax.dynamic_slice(tokens, (0, t), (b, 1))
-        logits, mut = model.apply(
-            {"params": variables["params"], "cache": cache}, tok, t,
-            method=decode_step, mutable=["cache"])
-        logits = logits[:, 0, :]
+        logits, cache = logits_at(tokens, cache, t)
         if temperature > 0.0:
             rng, sub = jax.random.split(rng)
             scaled = logits / temperature
@@ -276,10 +296,15 @@ def lm_generate(model, variables, prompt_ids, max_new_tokens: int,
         nxt = jnp.where(keep_prompt, cur, nxt)
         tokens = jax.lax.dynamic_update_slice(tokens, nxt[:, None],
                                               (0, t + 1))
-        return (tokens, mut["cache"], rng), None
+        return (tokens, cache, rng), None
 
+    # Cached mode must walk every position (the prompt steps populate the
+    # KV cache); recompute mode depends on nothing from earlier steps, so
+    # it starts at the last prompt position and skips p-1 wasted O(T²)
+    # forwards.
+    start = 0 if cached else p - 1
     (tokens, _, _), _ = jax.lax.scan(
-        step, (tokens, cache, rng), jnp.arange(total - 1))
+        step, (tokens, cache, rng), jnp.arange(start, total - 1))
     return tokens
 
 

@@ -176,6 +176,26 @@ def test_lm_generate_greedy_matches_manual_rollout():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(manual))
 
 
+def test_lm_generate_recompute_fallback_for_gpt_long():
+    """Models without decode_step (gpt_long) take the recompute drive
+    mode — greedy output must still equal the brute-force rollout."""
+    from deeplearning_cfn_tpu.models.decoding import lm_generate
+
+    model = build_model("gpt_long", 0, jnp.float32, vocab_size=32,
+                        hidden_size=32, num_layers=1, num_heads=2,
+                        mlp_dim=64, max_len=16)
+    assert not hasattr(type(model), "decode_step")
+    prompt = jnp.array([[3, 7, 1]], jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), prompt, train=False)
+    out = lm_generate(model, variables, prompt, max_new_tokens=5)
+    manual = prompt
+    for _ in range(5):
+        logits = model.apply(variables, manual, train=False)
+        nxt = jnp.argmax(logits[:, -1, :], -1).astype(jnp.int32)
+        manual = jnp.concatenate([manual, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(manual))
+
+
 def test_lm_generate_sampling_is_seeded_and_in_vocab():
     from deeplearning_cfn_tpu.models.decoding import lm_generate
 
