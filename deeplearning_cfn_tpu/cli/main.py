@@ -452,6 +452,56 @@ def _cmd_doctor(args) -> int:
     return 0 if ok else 1
 
 
+def _cmd_metrics(args) -> int:
+    """Operator's at-a-glance run summary from the JSONL stream."""
+    path = args.path
+    if os.path.isdir(path):
+        path = os.path.join(path, "metrics.jsonl")
+    if not os.path.exists(path):
+        print(f"[dlcfn-tpu] ERROR: no metrics file at {path}",
+              file=sys.stderr)
+        return 1
+    # Lenient parse: the writer is append-mode and tailed live, so a run
+    # killed mid-write leaves a truncated last line — skip bad lines
+    # (counted) instead of tracebacking on them.
+    records, skipped = [], 0
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                skipped += 1
+    train = [r for r in records if "examples_per_sec" in r]
+    evals = [r for r in records
+             if any(k.startswith("eval_") for k in r)]
+    finals = [r for r in records
+              if any(k.startswith("final_eval_") for k in r)]
+    out = {"path": path, "records": len(records)}
+    if skipped:
+        out["skipped_malformed_lines"] = skipped
+    if train:
+        last = train[-1]
+        out["last_step"] = last.get("step")
+        out["last_loss"] = last.get("loss")
+        rates = [r["examples_per_sec"] for r in train]
+        out["mean_examples_per_sec"] = round(sum(rates) / len(rates), 2)
+    if evals:
+        accs = [(r.get("eval_accuracy"), r.get("step")) for r in evals
+                if r.get("eval_accuracy") is not None]
+        if accs:
+            best = max(accs)
+            out["best_eval_accuracy"] = best[0]
+            out["best_eval_accuracy_step"] = best[1]
+    if finals:
+        out["final"] = {k: v for k, v in finals[-1].items()
+                        if k.startswith("final_eval_")}
+    print(json.dumps(out))
+    return 0
+
+
 def _cmd_ckpt_list(args) -> int:
     from ..ckpt import committed_steps
 
@@ -675,6 +725,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="comma-separated global batch sizes to bench in "
                          "sequence (one JSON line each), e.g. 256,512,768")
     be.set_defaults(fn=_cmd_bench)
+
+    met = sub.add_parser(
+        "metrics",
+        help="summarize a run's metrics.jsonl (last step, best eval, "
+             "mean throughput)")
+    met.add_argument("path", help="metrics.jsonl path (or its directory)")
+    met.set_defaults(fn=_cmd_metrics)
 
     # ckpt -------------------------------------------------------------------
     ck = sub.add_parser("ckpt", help="checkpoint inspection / rollback")

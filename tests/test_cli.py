@@ -3,6 +3,7 @@ user-facing contract (SURVEY.md §4.1/§4.4), exercised end-to-end against the
 dry-run control plane."""
 
 import json
+import os
 import sys
 
 import pytest
@@ -139,6 +140,30 @@ def test_eval_verb_standalone(tmp_path, capsys):
     # Evaluating a workdir with no checkpoints errors loudly.
     assert main(["eval", "--preset", "cifar10_resnet20",
                  "--accelerator", "cpu", f"workdir={tmp_path}/empty"]) == 1
+
+
+def test_metrics_summary_verb(tmp_path, capsys):
+    """`metrics` summarizes a run's JSONL: last train step, best eval,
+    throughput, and the final acceptance metrics."""
+    common = [
+        "--preset", "cifar10_resnet20", "--accelerator", "cpu",
+        f"workdir={tmp_path}", "train.global_batch=32", "train.steps=8",
+        "train.log_every_steps=2", "train.eval_every_steps=4",
+        "data.num_train_examples=64", "data.num_eval_examples=32",
+        "train.eval_batch=32", "schedule.warmup_epochs=0",
+        "checkpoint.async_write=false", "data.prefetch=0",
+    ]
+    assert main(["train", *common]) == 0
+    capsys.readouterr()
+    rundir = os.path.join(str(tmp_path), "cifar10_resnet20")
+    assert main(["metrics", rundir]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["last_step"] == 8
+    assert rec["mean_examples_per_sec"] > 0
+    assert "final_eval_accuracy" in rec["final"]
+    assert "best_eval_accuracy" in rec
+
+    assert main(["metrics", str(tmp_path / "nope")]) == 1
 
 
 def test_ckpt_list_and_rollback_verbs(tmp_path, capsys):
