@@ -50,6 +50,14 @@ import time
 
 METRIC = "imagenet_resnet50_train_images_per_sec_per_chip"
 UNIT = "images/sec/chip"
+# Liveness pre-probe budget: a bare backend-init subprocess. A healthy
+# backend answers in <5 s (r03 measured session); the documented hang mode
+# blocks for hours. 40 s cleanly separates the two. The attempts' deadline
+# is computed AFTER the probe returns, so the probe does not erode attempt
+# 1's window (the r02 slow-init mode needs the full ~440 s); worst-case
+# total wall is PROBE + TOTAL_BUDGET = 40+540 = 580 s, still under the
+# driver's ~600 s kill observed in r01.
+PROBE_TIMEOUT_S = int(os.environ.get("DLCFN_BENCH_PROBE_TIMEOUT_S", "40"))
 # Hard wall for the whole wrapper: it must finish (and print the contract
 # JSON) before the DRIVER's own timeout kills it — r01's harness killed the
 # multichip gate at ~600 s, so stay safely under that.
@@ -83,6 +91,49 @@ def _last_stage(stderr) -> str:
     return stages[-1] if stages else "no stage marker (died before main)"
 
 
+def _probe_backend() -> tuple[bool, str]:
+    """Backend-liveness probe (PROBE_TIMEOUT_S, default 40 s) in a
+    throwaway subprocess.
+
+    Returns (alive, note). A dead probe does NOT veto the real attempts —
+    the r02 slow-init mode (280-600 s) would fail a short probe yet succeed
+    a long attempt — it only tells the diagnosis which mode we are in.
+    """
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "from deeplearning_cfn_tpu.runtime.platform import honor_env_platform; "
+             "honor_env_platform(); "
+             "import jax; d = jax.devices()[0]; "
+             "print(d.platform, len(jax.devices()))"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+            cwd=REPO_ROOT,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe: backend_init hung >{PROBE_TIMEOUT_S}s"
+    dt = time.monotonic() - t0
+    if proc.returncode == 0:
+        platform = (proc.stdout or "").split()[0] if proc.stdout else "?"
+        # A CPU answer is only "alive" when CPU was explicitly requested;
+        # otherwise it is jax silently falling back from a DEAD accelerator
+        # plugin (the r01 raise-then-fallback mode) and must read as red.
+        cpu_requested = os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+        if platform == "cpu" and not cpu_requested:
+            return False, (f"probe: accelerator plugin dead — jax fell back "
+                           f"to cpu in {dt:.1f}s")
+        return True, f"probe: {platform} backend alive in {dt:.1f}s"
+    tail = (proc.stderr or "").strip().splitlines()[-1:] or ["?"]
+    return False, f"probe: rc={proc.returncode} after {dt:.1f}s ({tail[0][:200]})"
+
+
+def _artifact_path() -> str:
+    d = os.path.join(REPO_ROOT, "bench_artifacts")
+    os.makedirs(d, exist_ok=True)
+    stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    return os.path.join(d, f"bench_run_{stamp}.log")
+
+
 def main() -> None:
     child = [
         sys.executable, "-m", "deeplearning_cfn_tpu.bench",
@@ -94,6 +145,22 @@ def main() -> None:
     if gb:
         child += ["--global-batch", gb]
     errors = []
+    artifact = _artifact_path()
+    rel_artifact = os.path.relpath(artifact, REPO_ROOT)
+
+    def _log(text: str) -> None:
+        with open(artifact, "a") as f:
+            f.write(text if text.endswith("\n") else text + "\n")
+
+    _log(f"==== bench.py run {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}"
+         f" budget={TOTAL_BUDGET_S}s child={' '.join(child)} ====")
+    alive, probe_note = _probe_backend()
+    _log(probe_note)
+    if not alive:
+        errors.append(probe_note)
+    # Deadline starts AFTER the probe so a hung probe doesn't shrink attempt
+    # 1 below the slow-init window (see PROBE_TIMEOUT_S comment for the
+    # total-wall arithmetic).
     deadline = time.monotonic() + TOTAL_BUDGET_S
     for attempt in (1, 2):
         remaining = deadline - time.monotonic()
@@ -106,20 +173,44 @@ def main() -> None:
         attempt_timeout = int(remaining - ATTEMPT_RESERVE_S) \
             if attempt == 1 else int(remaining)
         attempt_timeout = max(attempt_timeout, 60)
+        _log(f"--- attempt {attempt} (timeout {attempt_timeout}s) ---")
         try:
             proc = subprocess.run(
                 child, capture_output=True, text=True,
                 timeout=attempt_timeout, cwd=REPO_ROOT,
             )
         except subprocess.TimeoutExpired as e:
+            stderr = e.stderr
+            if isinstance(stderr, bytes):
+                stderr = stderr.decode("utf-8", "replace")
+            _log(f"TIMEOUT after {attempt_timeout}s; captured stderr:")
+            _log(stderr or "(none)")
             errors.append(
                 f"attempt {attempt}: timeout after {attempt_timeout}s; "
                 f"last stage: {_last_stage(e.stderr)}"
             )
             continue
+        _log("stdout:")
+        _log(proc.stdout or "(none)")
+        _log("stderr:")
+        _log(proc.stderr or "(none)")
         record = _parse_record(proc.stdout)
         if proc.returncode == 0 and record is not None:
             record.setdefault("measured", True)
+            record["artifact"] = rel_artifact
+            record["probe"] = probe_note
+            # Enforce the probe's cpu-fallback verdict: a child that ran on
+            # the CPU fallback of a dead accelerator plugin must not ship a
+            # green measured=true number against the TPU contract.
+            cpu_requested = os.environ.get(
+                "JAX_PLATFORMS", "").startswith("cpu")
+            if (not cpu_requested and not alive
+                    and record.get("device_kind") == "cpu"):
+                record["measured"] = False
+                record["error"] = ("child completed on the CPU fallback of a "
+                                   "dead accelerator plugin; " + probe_note)
+            _log(f"==== {'GREEN' if record['measured'] else 'RED'}: "
+                 f"{json.dumps(record)} ====")
             print(json.dumps(record))
             return
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-4:]
@@ -127,15 +218,18 @@ def main() -> None:
             f"attempt {attempt}: rc={proc.returncode}; last stage: "
             f"{_last_stage(proc.stderr)}; tail: " + " | ".join(tail)
         )
-    print(json.dumps({
+    red = {
         "metric": METRIC,
         "value": 0.0,
         "unit": UNIT,
         "vs_baseline": 0.0,
         "mfu": 0.0,
         "measured": False,
+        "artifact": rel_artifact,
         "error": " || ".join(errors)[-2000:],
-    }))
+    }
+    _log(f"==== RED: {json.dumps(red)} ====")
+    print(json.dumps(red))
 
 
 if __name__ == "__main__":

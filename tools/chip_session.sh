@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # On-chip measurement session: run everything worth measuring on the real
 # TPU in one unattended pass, appending JSON lines + markers to a log.
-# Usage: tools/chip_session.sh [LOGFILE]   (default /tmp/chip_session.log)
+# Usage: tools/chip_session.sh [LOGFILE]
+#   (default bench_artifacts/chip_session_<UTC>.log — committed evidence)
 #
 # Designed for the flaky-backend reality: every stage is its own process
 # with a hard timeout, failures don't stop later stages, and the log
@@ -9,8 +10,9 @@
 # mid-session backend death still leaves the headline numbers.
 
 set -u
-LOG="${1:-/tmp/chip_session.log}"
 cd "$(dirname "$0")/.."
+mkdir -p bench_artifacts
+LOG="${1:-bench_artifacts/chip_session_$(date -u +%Y%m%dT%H%M%SZ).log}"
 
 stage() {
   local name="$1" tmo="$2"; shift 2
@@ -25,8 +27,9 @@ echo "==== chip session start $(date) ====" >> "$LOG"
 #    later stages still run, in case the hang was transient.)
 stage doctor            180 python -m deeplearning_cfn_tpu.cli doctor
 
-# 1. Headline driver bench (ResNet-50, full contract line).
-stage bench_headline    560 python bench.py
+# 1. Headline driver bench (ResNet-50, full contract line). Timeout must
+#    exceed bench.py's worst-case wall: 40 s probe + 540 s attempt budget.
+stage bench_headline    630 python bench.py
 
 # 2. ResNet batch sweep around the shipped 512 default.
 stage sweep_resnet      900 python -m deeplearning_cfn_tpu.cli bench \

@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# Backend-recovery watcher, round-4 edition: poll the TPU backend; the
+# moment it answers, run the full measurement session with its log INSIDE
+# the repo (bench_artifacts/) and git-commit the capture immediately, so a
+# later container death cannot lose the evidence (round-3 verdict, Weak #1:
+# /tmp artifacts die with the container).
+#
+# Usage: tools/chip_watch.sh [MAX_POLLS] [POLL_INTERVAL_S]
+# Runs in the foreground; callers background it themselves.
+
+set -u
+MAX_POLLS="${1:-400}"
+INTERVAL="${2:-90}"
+cd "$(dirname "$0")/.."
+STAMP() { date -u +%Y%m%dT%H%M%SZ; }
+ART=bench_artifacts
+PROBE_LOG="$ART/probe_$(STAMP).log"
+mkdir -p "$ART"
+
+# The probe must assert a real accelerator: in the r01 failure mode the TPU
+# plugin RAISES and jax silently falls back to CPU, where a bare matmul
+# succeeds — that must not trigger (and thereby spend) the one-shot session.
+PROBE='import jax, jax.numpy as jnp
+d = jax.devices()[0]
+assert d.platform != "cpu", f"cpu fallback: {d}"
+x = jnp.ones((256, 256)); print(d.platform, float((x @ x).sum()))'
+
+commit_artifacts() {
+  # Pathspec'd commit so concurrently-staged unrelated work is never swept
+  # in; retried because a 10 h watch window can race another git operation
+  # (stale index.lock). Returns nonzero if the evidence is NOT durable.
+  local msg="$1"
+  for try in 1 2 3; do
+    if git add -- "$ART" >> "$PROBE_LOG" 2>&1 \
+       && git commit -m "$msg" -- "$ART" >> "$PROBE_LOG" 2>&1; then
+      return 0
+    fi
+    # "nothing to commit" (all artifacts already committed) is success.
+    if git diff --quiet HEAD -- "$ART" 2>/dev/null \
+       && [ -z "$(git status --porcelain -- "$ART")" ]; then
+      return 0
+    fi
+    echo "$(STAMP) commit attempt $try failed, retrying in 10s" >> "$PROBE_LOG"
+    sleep 10
+  done
+  echo "$(STAMP) ERROR: artifacts NOT committed" >> "$PROBE_LOG"
+  return 1
+}
+
+echo "$(STAMP) watcher armed (max $MAX_POLLS polls @ ${INTERVAL}s)" >> "$PROBE_LOG"
+for i in $(seq 1 "$MAX_POLLS"); do
+  if timeout 120 python -c "$PROBE" >> "$PROBE_LOG" 2>&1; then
+    echo "$(STAMP) TPU OK (poll $i) — launching chip session" >> "$PROBE_LOG"
+    SESSION_LOG="$ART/chip_session_$(STAMP).log"
+    bash tools/chip_session.sh "$SESSION_LOG"
+    echo "$(STAMP) chip session finished" >> "$PROBE_LOG"
+    commit_artifacts "bench_artifacts: real-chip measurement session $(STAMP)"
+    exit $?
+  fi
+  echo "$(STAMP) still hung (poll $i)" >> "$PROBE_LOG"
+  sleep "$INTERVAL"
+done
+echo "$(STAMP) watcher exhausted without a live backend" >> "$PROBE_LOG"
+commit_artifacts "bench_artifacts: probe log — backend never recovered"
+exit 1
