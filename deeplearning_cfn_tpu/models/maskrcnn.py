@@ -142,7 +142,16 @@ class BoxHead(nn.Module):
 
 
 class MaskHead(nn.Module):
-    """4 convs + 2× deconv → per-class mask logits at 2×roi resolution."""
+    """4 convs + 2× upsample → per-class mask logits at 2×roi resolution.
+
+    The 2× upsample is the Mask R-CNN 2×2/stride-2 transposed conv, but
+    written as Dense(4·C) + depth-to-space: with kernel == stride there is
+    no tap overlap, so the transposed conv is exactly four independent
+    per-pixel projections — one [C, 4·C] matmul that XLA maps straight onto
+    the MXU. The naive ``nn.ConvTranspose`` lowering was measured ~110×
+    slower in backward than forward (0.34 s fwd / 37 s fwd+bwd on the CPU
+    microbench at preset shapes); the matmul form has matmul gradients.
+    """
 
     num_classes: int
     dtype: Any = jnp.bfloat16
@@ -155,10 +164,18 @@ class MaskHead(nn.Module):
             x = nn.relu(nn.Conv(FPN_DIM, (3, 3), padding="SAME",
                                 dtype=self.dtype, param_dtype=jnp.float32,
                                 name=f"conv_{i}")(x))
-        x = nn.relu(nn.ConvTranspose(FPN_DIM, (2, 2), strides=(2, 2),
-                                     dtype=self.dtype,
-                                     param_dtype=jnp.float32,
-                                     name="deconv")(x))
+        # y[2i+a, 2j+b, o] = Σ_c x[i,j,c]·W[(a,b,o),c] — transposed conv with
+        # kernel==stride, as one matmul + pixel shuffle.
+        # variance_scaling(0.25) reproduces the replaced ConvTranspose's
+        # init std (its 2x2 kernel saw fan_in=4C; Dense sees C).
+        x = nn.Dense(4 * FPN_DIM, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="upsample",
+                     kernel_init=nn.initializers.variance_scaling(
+                         0.25, "fan_in", "truncated_normal"))(x)
+        x = x.reshape(b * n, s, s, 2, 2, FPN_DIM)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b * n, 2 * s, 2 * s,
+                                                  FPN_DIM)
+        x = nn.relu(x)
         x = nn.Conv(self.num_classes, (1, 1), dtype=jnp.float32,
                     name="mask_logits")(x)
         return x.reshape(b, n, 2 * s, 2 * s, self.num_classes)

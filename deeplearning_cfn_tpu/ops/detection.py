@@ -19,6 +19,7 @@ from typing import Dict, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 EPS = 1e-8
 
@@ -219,20 +220,93 @@ def multilevel_roi_align(
     strides: Dict[int, int],
     canonical_level: int = 4,
     canonical_size: float = 224.0,
+    sampling_ratio: int = 2,
 ) -> jnp.ndarray:
     """FPN ROI-align: assign each box to a pyramid level by size (the FPN
-    k = k0 + log2(√area/224) rule), align on every level, and select —
-    static-shape alternative to gathering per-level subsets."""
+    k = k0 + log2(√area/224) rule) and bilinear-sample it at THAT level only.
+
+    The whole pyramid is flattened once into a [ΣHₗWₗ, C] row table; each
+    box's sample points become flat row indices (level offset + y·Wₗ + x),
+    so the op is 4 row-gathers + separable bilinear weights regardless of
+    level count. The earlier formulation aligned every box on every level
+    and one-hot-selected, costing L× the gather traffic and interp math and
+    materializing an [L,N,os,os,C] f32 stack — on TPU, gather traffic IS
+    the cost of ROI-align (it never touches the MXU), so per-level
+    assignment before the gather is the whole optimization.
+
+    Level-dependent scalars (stride, Hₗ, Wₗ, row offset) are [L]-constant
+    lookups by the box's target level — data-dependent values, static
+    shapes, XLA-friendly.
+    """
     levels = sorted(feats)
+    if levels != list(range(levels[0], levels[-1] + 1)):
+        raise ValueError(
+            f"pyramid levels must be contiguous integers (the level->table "
+            f"index mapping assumes it), got {levels}")
+    c = feats[levels[0]].shape[-1]
+    n = boxes.shape[0]
+    s = sampling_ratio
+    S = out_size * s
+
     sqrt_area = jnp.sqrt(jnp.maximum(box_area(boxes), EPS))
     target = jnp.floor(canonical_level +
                        jnp.log2(sqrt_area / canonical_size + EPS))
     target = jnp.clip(target, levels[0], levels[-1]).astype(jnp.int32)
-    outs = []
-    for lvl in levels:
-        outs.append(roi_align(feats[lvl], boxes, out_size,
-                              spatial_scale=1.0 / strides[lvl]))
-    stacked = jnp.stack(outs, axis=0)  # [L, N, os, os, C]
-    sel = (target[None, :] == jnp.asarray(
-        levels, jnp.int32)[:, None]).astype(stacked.dtype)
-    return jnp.einsum("lnhwc,ln->nhwc", stacked, sel)
+    tidx = target - levels[0]  # [N] index into the level tables
+
+    hs = np.asarray([feats[l].shape[0] for l in levels], np.int32)
+    ws = np.asarray([feats[l].shape[1] for l in levels], np.int32)
+    offs = np.concatenate([[0], np.cumsum(hs.astype(np.int64) * ws)[:-1]])
+    flat = jnp.concatenate([feats[l].reshape(-1, c) for l in levels], axis=0)
+
+    inv_stride = jnp.asarray(
+        [1.0 / strides[l] for l in levels], jnp.float32)[tidx]  # [N]
+    hl = jnp.asarray(hs)[tidx].astype(jnp.float32)  # [N]
+    wl = jnp.asarray(ws)[tidx].astype(jnp.float32)
+    off = jnp.asarray(offs, jnp.int32)[tidx]  # [N]
+
+    bl = boxes.astype(jnp.float32) * inv_stride[:, None]  # level coords
+    by0, bx0, by1, bx1 = bl[:, 0], bl[:, 1], bl[:, 2], bl[:, 3]
+    cell_h = jnp.maximum(by1 - by0, EPS) / out_size
+    cell_w = jnp.maximum(bx1 - bx0, EPS) / out_size
+    grid = (jnp.arange(S, dtype=jnp.float32) + 0.5) / s  # [S] in cell units
+    ys = by0[:, None] + grid[None, :] * cell_h[:, None] - 0.5  # [N, S]
+    xs = bx0[:, None] + grid[None, :] * cell_w[:, None] - 0.5
+
+    def axis_taps(coords, size):
+        """coords [N,S], per-box size [N] → (i0, i1 [N,S] int32 clipped;
+        w0, w1 [N,S] f32 with the outside-map mask folded in)."""
+        i0 = jnp.floor(coords)
+        frac = coords - i0
+        inside = (coords >= -1) & (coords <= size[:, None])
+        i0i = i0.astype(jnp.int32)
+        hi = (size[:, None] - 1).astype(jnp.int32)
+        i0c = jnp.clip(i0i, 0, hi)
+        i1c = jnp.clip(i0i + 1, 0, hi)
+        w1 = frac * inside
+        w0 = (1.0 - frac) * inside
+        return i0c, i1c, w0, w1
+
+    y0c, y1c, wy0, wy1 = axis_taps(ys, hl)
+    x0c, x1c, wx0, wx1 = axis_taps(xs, wl)
+
+    wli = wl.astype(jnp.int32)
+
+    def corner(yc, xc):
+        """Row-gather one corner: [N,S] y × [N,S] x → [N,S,S,C]."""
+        idx = (off[:, None, None] + yc[:, :, None] * wli[:, None, None]
+               + xc[:, None, :])  # [N, S, S]
+        return jnp.take(flat, idx.reshape(-1), axis=0).reshape(n, S, S, c)
+
+    v00 = corner(y0c, x0c)
+    v01 = corner(y0c, x1c)
+    v10 = corner(y1c, x0c)
+    v11 = corner(y1c, x1c)
+    wy0_ = wy0[:, :, None, None]
+    wy1_ = wy1[:, :, None, None]
+    wx0_ = wx0[:, None, :, None]
+    wx1_ = wx1[:, None, :, None]
+    samples = (v00 * (wy0_ * wx0_) + v01 * (wy0_ * wx1_) +
+               v10 * (wy1_ * wx0_) + v11 * (wy1_ * wx1_))  # [N,S,S,C] f32
+    pooled = samples.reshape(n, out_size, s, out_size, s, c).mean((2, 4))
+    return pooled.astype(feats[levels[0]].dtype)

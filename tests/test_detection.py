@@ -123,6 +123,68 @@ def test_multilevel_roi_align_routes_by_size():
     assert np.allclose(np.asarray(out)[1], 3.0)
 
 
+def test_multilevel_roi_align_matches_dense_reference():
+    """The flat-pyramid single-gather formulation must equal the dense
+    reference (align every box on every level, one-hot select by target
+    level) bit-for-bit in f32 — including boxes hanging off the map edge
+    and degenerate boxes."""
+    import jax
+
+    rng = np.random.RandomState(0)
+    strides = {2: 4, 3: 8, 4: 16}
+    feats = {
+        lvl: jnp.asarray(rng.randn(64 // (2 ** i), 64 // (2 ** i), 8),
+                         jnp.float32)
+        for i, lvl in enumerate(sorted(strides))
+    }
+    boxes = jnp.asarray(np.concatenate([
+        rng.uniform(0, 256, (12, 4)),
+        [[(-8.0), -8.0, 20.0, 20.0],     # off the top-left edge
+         [200.0, 200.0, 400.0, 400.0],   # off the bottom-right edge
+         [17.0, 17.0, 17.0, 17.0]],      # degenerate (zero-area)
+    ]), jnp.float32)
+    boxes = jnp.stack([
+        jnp.minimum(boxes[:, 0], boxes[:, 2]),
+        jnp.minimum(boxes[:, 1], boxes[:, 3]),
+        jnp.maximum(boxes[:, 0], boxes[:, 2]),
+        jnp.maximum(boxes[:, 1], boxes[:, 3]),
+    ], axis=1)
+
+    def dense_reference(feats, boxes, out_size):
+        levels = sorted(feats)
+        from deeplearning_cfn_tpu.ops.detection import EPS, box_area
+        sqrt_area = jnp.sqrt(jnp.maximum(box_area(boxes), EPS))
+        target = jnp.floor(4 + jnp.log2(sqrt_area / 224.0 + EPS))
+        target = jnp.clip(target, levels[0], levels[-1]).astype(jnp.int32)
+        outs = [roi_align(feats[lvl], boxes, out_size,
+                          spatial_scale=1.0 / strides[lvl])
+                for lvl in levels]
+        stacked = jnp.stack(outs, axis=0)
+        sel = (target[None, :] == jnp.asarray(
+            levels, jnp.int32)[:, None]).astype(stacked.dtype)
+        return jnp.einsum("lnhwc,ln->nhwc", stacked, sel)
+
+    for out_size in (7, 14):
+        got = multilevel_roi_align(feats, boxes, out_size, strides)
+        want = dense_reference(feats, boxes, out_size)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    # Gradients must match too (the align sits inside the train step).
+    def loss_new(f):
+        return jnp.sum(multilevel_roi_align(f, boxes, 7, strides) ** 2)
+
+    def loss_ref(f):
+        return jnp.sum(dense_reference(f, boxes, 7) ** 2)
+
+    g_new = jax.grad(loss_new)(feats)
+    g_ref = jax.grad(loss_ref)(feats)
+    for lvl in feats:
+        np.testing.assert_allclose(np.asarray(g_new[lvl]),
+                                   np.asarray(g_ref[lvl]),
+                                   rtol=1e-4, atol=1e-4)
+
+
 def test_generate_anchors_layout():
     anchors = generate_anchors((32, 32), strides=[8, 16], scales=[16, 32])
     # 4*4*3 + 2*2*3 anchors, all finite, centers inside the image.

@@ -43,6 +43,11 @@ stage ops_resnet        900 python -m deeplearning_cfn_tpu.cli bench \
 stage ops_detection    1500 python -m deeplearning_cfn_tpu.cli bench \
     --ops detection --steps 5
 
+# 4b. Detection batch sweep: the preset trains at 64/chip-group but the
+#     single-number bench ran at 4, under-filling the chip (r03 Weak #5).
+stage sweep_detection  1200 python -m deeplearning_cfn_tpu.cli bench \
+    --preset maskrcnn_coco --steps 8 --sweep-batches 4,8,16
+
 # 5. Per-preset step benches not covered above.
 for p in bert_base_wikipedia transformer_nmt_wmt maskrcnn_coco \
          bert_moe_wikipedia bert_long_wikipedia gpt_small_lm \
