@@ -29,6 +29,22 @@ def stage(name: str, **info) -> None:
 # paper arXiv:1802.05799). The reference itself publishes no numbers.
 HOROVOD_V100_IMG_PER_SEC_PER_GPU = 375.0
 
+# Presets whose MFU numerator must come from a DENSE-equivalent compile:
+# XLA's cost analysis counts a lax.scan body once, so the GPipe schedule's
+# double scan (ticks × stage layers) under-counts the trunk by ~T·L/S —
+# the r03 "0.05*" footnote. The dense twin computes the same math with the
+# layer loop unrolled, so ITS cost analysis is the honest useful-FLOPs
+# count at identical shapes (same hidden/layers/heads/seq contract,
+# asserted at bench time).
+_DENSE_FLOPS_EQUIV = {
+    "bert_pipelined_wikipedia": "bert_base_wikipedia",
+}
+
+# Presets whose parallelism strategy needs a >1 mesh axis to engage: on a
+# single chip they run a DENSE fallback, and the number must say so
+# (r03 Weak #4 — a fallback number must never read as a ring measurement).
+_SEQ_PARALLEL_PRESETS = {"bert_long_wikipedia", "gpt_long_lm"}
+
 _UNITS = {
     "cifar10_resnet20": "images/sec/chip",
     "imagenet_resnet50": "images/sec/chip",
@@ -83,6 +99,68 @@ def _flops_of(compiled) -> Optional[float]:
         return None
 
 
+def annotate_record(record: Dict, preset: str, mesh_shape: Dict[str, int],
+                    gb: int, preset_gb: int) -> Dict:
+    """Fallback/underfill labels (r03 Weak #4/#5): a number measured in a
+    degraded configuration must say so in the artifact itself."""
+    if preset in _SEQ_PARALLEL_PRESETS:
+        seq_ways = int(mesh_shape.get("seq", 1))
+        record["fallback"] = seq_ways == 1
+        if seq_ways == 1:
+            record["fallback_note"] = (
+                "dense-attention fallback (mesh seq=1): NOT a ring/Ulysses "
+                "sequence-parallel measurement")
+    if gb < preset_gb:
+        record["batch_underfilled"] = True
+        record["preset_global_batch"] = preset_gb
+    return record
+
+
+def _dense_equiv_flops(preset: str, cfg, mesh, gb: int) -> Optional[float]:
+    """Per-device FLOPs of the dense twin of a scanned preset (see
+    _DENSE_FLOPS_EQUIV): same shapes, layer loop unrolled, AOT-compiled on
+    the same mesh purely for cost analysis — never executed."""
+    import jax
+
+    from .config import apply_overrides
+    from .data import build_pipeline
+    from .parallel.mesh import local_batch_size
+    from .presets import get_preset
+    from .train import create_train_state
+    from .train.optim import build_optimizer, build_schedule
+    from .train.task import build_task
+    from .train.trainer import Trainer
+
+    dcfg = get_preset(_DENSE_FLOPS_EQUIV[preset])
+    dcfg.train.global_batch = gb
+    dcfg.train.grad_accum_steps = 1
+    dcfg.data.seq_len = cfg.data.seq_len
+    dcfg.data.vocab_size = cfg.data.vocab_size
+    for k in ("hidden_size", "num_layers", "num_heads", "mlp_dim",
+              "max_len"):
+        if k in cfg.model.kwargs:
+            dcfg.model.kwargs[k] = cfg.model.kwargs[k]
+    apply_overrides(dcfg, ["data.prefetch=0", "data.synthetic=true"])
+    dcfg.data.num_train_examples = gb
+    dcfg.data.num_eval_examples = gb
+    task = build_task(dcfg, mesh=mesh)
+    sched = build_schedule(dcfg.schedule, 1000, gb, 100)
+    tx = build_optimizer(dcfg.optimizer, sched)
+    state = create_train_state(
+        jax.random.PRNGKey(0), task.init, tx, mesh,
+        param_rules=getattr(task, "param_rules", ()),
+        shard_opt_state=dcfg.train.shard_opt_state)
+    trainer = Trainer(dcfg, task.loss_fn, tx, mesh=mesh,
+                      spatial_dim=getattr(task, "spatial_dim", None),
+                      spatial_keys=getattr(task, "spatial_keys", None))
+    pipe = build_pipeline(dcfg.data, local_batch_size(gb, mesh),
+                          dcfg.model.num_classes, seed=0, train=True)
+    dev_batch = trainer.device_batch(next(iter(pipe.one_epoch(0))))
+    compiled = trainer.train_step.lower(
+        state, dev_batch, jax.random.PRNGKey(1)).compile()
+    return _flops_of(compiled)
+
+
 def run_bench(
     preset: str = "imagenet_resnet50",
     steps: int = 20,
@@ -129,6 +207,10 @@ def run_bench(
     cfg = get_preset(preset)
     if global_batch:
         cfg.train.global_batch = global_batch
+        # An explicit batch is a step-time probe like the single-chip
+        # default path: keeping the preset's accumulation factor would make
+        # sweep entries reject batches that don't divide it (ADVICE r3 #1).
+        cfg.train.grad_accum_steps = 1
     elif jax.device_count() == 1:
         # Single-chip bench: a per-chip-sized batch, not the pod-sized one.
         # Sized to saturate the MXU without blowing HBM; override with
@@ -205,8 +287,22 @@ def run_bench(
 
     # MFU: XLA-counted per-device FLOPs per step vs one chip's peak bf16
     # rate. 0.0 when the peak is unknown (CPU runs) or cost analysis is
-    # unavailable.
+    # unavailable. Scanned presets take their numerator from a dense-twin
+    # compile (cost analysis counts a scan body once — r03 Weak #3).
     flops = _flops_of(compiled_step)
+    mfu_source = "xla_cost_analysis"
+    if preset in _DENSE_FLOPS_EQUIV:
+        stage("dense_equiv_compile", twin=_DENSE_FLOPS_EQUIV[preset])
+        try:
+            dense_flops = _dense_equiv_flops(preset, cfg, mesh, gb)
+        except Exception as e:  # the twin is only a label source — a
+            # failure there (OOM from its extra state, preset drift) must
+            # not discard the already-measured step time.
+            dense_flops = None
+            mfu_source = f"xla_cost_analysis (dense twin failed: {e})"
+        if dense_flops:
+            flops = dense_flops
+            mfu_source = f"dense_equivalent:{_DENSE_FLOPS_EQUIV[preset]}"
     peak = peak_flops_per_chip(jax.devices()[0])
     mfu = flops / (mean_step_s * peak) if flops and peak else 0.0
 
@@ -231,8 +327,11 @@ def run_bench(
         # DENSE flash-attention fallback, not ring/Ulysses (those need a
         # seq axis > 1); the mesh field keeps that visible in the artifact.
         "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        "mfu_source": mfu_source,
         "measured": True,
     }
+    annotate_record(record, preset, dict(mesh.shape), gb,
+                    get_preset(preset).train.global_batch)
     # Post-run HBM occupancy (PJRT memory_stats; absent on CPU): how close
     # the chosen batch runs to the chip's limit — context for batch sweeps.
     try:

@@ -65,6 +65,13 @@ def _cmd_stack_create(args) -> int:
 def _cmd_stack_resize(args) -> int:
     from ..provision import ProvisionError, StackStore, resize_stack
 
+    # Destroy-first semantics must be visible BEFORE the irreversible step:
+    # if the replacement create fails (quota, capacity) the old stack is
+    # already gone (ADVICE r3 #3; TPU slices are not elastically resizable
+    # — see provision.resize_stack).
+    print(f"[dlcfn-tpu] resize: tearing down stack {args.name!r} before "
+          f"creating its {args.slice_type} replacement — if the new create "
+          f"fails, the old stack will NOT be restored", flush=True)
     try:
         state = resize_stack(args.name, args.slice_type,
                              store=StackStore(args.state_dir))
@@ -188,9 +195,11 @@ def _cmd_eval(args) -> int:
 
 
 def _cmd_generate(args) -> int:
-    """Sampling demo for the LM family: byte-level prompt → continuation.
-    Uses the lm_text byte tokenizer contract (data prepare-text): byte
-    values shifted past the 4 reserved special ids."""
+    """Sampling demo for the LM family: prompt → continuation.
+    Default tokenizer is the lm_text byte contract (data prepare-text):
+    byte values shifted past the 4 reserved special ids. With ``--vocab``
+    (a vocab.json from data prepare-wikipedia/prepare-wmt) the prompt is
+    BPE-encoded and the continuation BPE-decoded instead."""
     cfg = apply_overrides(get_preset(args.preset), args.overrides)
     if args.accelerator:
         cfg.stack.accelerator = args.accelerator
@@ -231,8 +240,20 @@ def _cmd_generate(args) -> int:
     try:
         restored, at_step = manager.restore_or_none(
             {"params": variables["params"]}, step=args.step)
-        prompt = jnp.asarray(
-            [[b + 4 for b in args.prompt.encode()]], jnp.int32)
+        bpe = None
+        if args.vocab:
+            from ..data.bpe import Bpe
+
+            bpe = Bpe.load(args.vocab)
+            prompt_ids = bpe.encode(args.prompt)
+            if not prompt_ids:
+                print("[dlcfn-tpu] ERROR: prompt encodes to zero tokens",
+                      file=sys.stderr)
+                return 1
+            prompt = jnp.asarray([prompt_ids], jnp.int32)
+        else:
+            prompt = jnp.asarray(
+                [[b + 4 for b in args.prompt.encode()]], jnp.int32)
         out = lm_generate(task.model, restored, prompt,
                           args.max_new_tokens,
                           temperature=args.temperature, top_k=args.top_k,
@@ -241,12 +262,15 @@ def _cmd_generate(args) -> int:
     except (FileNotFoundError, ValueError) as e:
         print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
         return 1
-    # Out-of-byte-range ids print as '?': ids 0-3 are specials, ids
-    # >= 260 exist whenever the model's vocab is larger than the byte
-    # tokenizer's (the default gpt_small_lm preset's 32768) — neither
-    # may crash the decoder.
-    text = bytes(int(t) - 4 if 4 <= int(t) < 260 else 0x3F
-                 for t in np.asarray(out[0])).decode(errors="replace")
+    if bpe is not None:
+        text = bpe.decode(np.asarray(out[0]))
+    else:
+        # Out-of-byte-range ids print as '?': ids 0-3 are specials, ids
+        # >= 260 exist whenever the model's vocab is larger than the byte
+        # tokenizer's (the default gpt_small_lm preset's 32768) — neither
+        # may crash the decoder.
+        text = bytes(int(t) - 4 if 4 <= int(t) < 260 else 0x3F
+                     for t in np.asarray(out[0])).decode(errors="replace")
     print(f"[dlcfn-tpu] checkpoint step {at_step}:")
     print(text)
     return 0
@@ -558,6 +582,47 @@ def _cmd_data_prepare_text(args) -> int:
     return 0
 
 
+def _cmd_data_prepare_wikipedia(args) -> int:
+    from ..data.text import prepare_mlm_text
+
+    try:
+        info = prepare_mlm_text(args.src, args.out, args.seq_len,
+                                vocab_size=args.vocab_size,
+                                eval_fraction=args.eval_fraction,
+                                vocab_path=args.vocab, seed=args.seed)
+    except (OSError, ValueError) as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    print(f"[dlcfn-tpu] wrote {info['train_examples']} train / "
+          f"{info['eval_examples']} eval examples to {args.out} "
+          f"(vocab {info['vocab_size']}); train with: "
+          f"--preset bert_base_wikipedia data.data_dir={args.out} "
+          f"data.synthetic=false data.vocab_size={info['vocab_size']} "
+          f"data.seq_len={info['seq_len']}")
+    return 0
+
+
+def _cmd_data_prepare_wmt(args) -> int:
+    from ..data.text import prepare_nmt_text
+
+    try:
+        info = prepare_nmt_text(args.src, args.tgt, args.out, args.seq_len,
+                                vocab_size=args.vocab_size,
+                                eval_fraction=args.eval_fraction,
+                                vocab_path=args.vocab)
+    except (OSError, ValueError) as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    print(f"[dlcfn-tpu] wrote {info['train_examples']} train / "
+          f"{info['eval_examples']} eval pairs to {args.out} "
+          f"(vocab {info['vocab_size']}, skipped {info['skipped_pairs']} "
+          f"over-length); train with: --preset transformer_nmt_wmt "
+          f"data.data_dir={args.out} data.synthetic=false "
+          f"data.vocab_size={info['vocab_size']} "
+          f"data.seq_len={info['seq_len']}")
+    return 0
+
+
 def _cmd_data_feed_rate(args) -> int:
     # Host-side measurement only — never initialize an accelerator backend
     # (the pipeline queries process_index for sharding).
@@ -678,6 +743,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="0 = greedy")
     gen.add_argument("--top-k", type=int, default=0)
     gen.add_argument("--seed", type=int, default=0)
+    gen.add_argument("--vocab", default="",
+                     help="BPE vocab.json (from data prepare-wikipedia/"
+                          "prepare-wmt); default is the byte tokenizer")
     gen.add_argument("--step", type=int, default=0,
                      help="committed checkpoint step (0 = latest)")
     gen.add_argument("overrides", nargs="*",
@@ -776,6 +844,35 @@ def build_parser() -> argparse.ArgumentParser:
     dt.add_argument("--seq-len", type=int, default=1024)
     dt.add_argument("--eval-fraction", type=float, default=0.05)
     dt.set_defaults(fn=_cmd_data_prepare_text)
+
+    dw = dsub.add_parser(
+        "prepare-wikipedia",
+        help="raw text corpus → BPE vocab + pre-masked MLM+NSP npz shards "
+             "(the wikipedia_mlm real-data contract)")
+    dw.add_argument("--src", required=True, help="raw UTF-8 text file")
+    dw.add_argument("--out", required=True, help="output directory")
+    dw.add_argument("--seq-len", type=int, default=512)
+    dw.add_argument("--vocab-size", type=int, default=8192,
+                    help="total ids incl. 4 specials + 256 bytes")
+    dw.add_argument("--vocab", default="",
+                    help="reuse an existing vocab.json instead of training")
+    dw.add_argument("--eval-fraction", type=float, default=0.05)
+    dw.add_argument("--seed", type=int, default=0)
+    dw.set_defaults(fn=_cmd_data_prepare_wikipedia)
+
+    dm = dsub.add_parser(
+        "prepare-wmt",
+        help="parallel src/tgt line files → shared BPE vocab + seq2seq npz "
+             "shards (the wmt_en_de real-data contract)")
+    dm.add_argument("--src", required=True, help="source-language lines")
+    dm.add_argument("--tgt", required=True, help="target-language lines")
+    dm.add_argument("--out", required=True, help="output directory")
+    dm.add_argument("--seq-len", type=int, default=128)
+    dm.add_argument("--vocab-size", type=int, default=8192)
+    dm.add_argument("--vocab", default="",
+                    help="reuse an existing vocab.json instead of training")
+    dm.add_argument("--eval-fraction", type=float, default=0.05)
+    dm.set_defaults(fn=_cmd_data_prepare_wmt)
 
     df = dsub.add_parser(
         "feed-rate",

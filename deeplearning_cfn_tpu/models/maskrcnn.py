@@ -151,6 +151,13 @@ class MaskHead(nn.Module):
     the MXU. The naive ``nn.ConvTranspose`` lowering was measured ~110×
     slower in backward than forward (0.34 s fwd / 37 s fwd+bwd on the CPU
     microbench at preset shapes); the matmul form has matmul gradients.
+
+    Checkpoint compatibility: this rework (round 4) renamed the parameter
+    ``deconv`` (ConvTranspose kernel [2,2,C,Cout]) to ``upsample`` (Dense
+    kernel [C, 4·Cout]); detection checkpoints from before it need a
+    one-time convert:
+    ``W_dense = W_convT.transpose(2, 0, 1, 3).reshape(C, 4 * Cout)``
+    (the (a, b, out) ordering matches the depth-to-space reshape below).
     """
 
     num_classes: int

@@ -175,6 +175,16 @@ class Trainer:
             # reshape+transpose of its contiguous shard — no cross-device
             # resharding — and batch rows are i.i.d., so the partition
             # choice is semantically free.
+            #
+            # Averaging contract (pinned by test_trainer.py's accum
+            # equivalence test): microbatch means are averaged UNIFORMLY,
+            # which is exactly DP-over-`accum`-more-devices semantics
+            # (each device means its shard locally, psum-mean across).
+            # For token-weighted losses with ragged masks this is NOT
+            # bit-equal to accum=1 on the same global batch (that would
+            # weight microbatches by their mask sums); matching the DP
+            # contract is the deliberate choice — accum exists to emulate
+            # a larger device count (ADVICE r3 #4).
             def split(v):
                 g = v.shape[0]
                 return v.reshape(g // accum, accum, *v.shape[1:]) \
@@ -379,7 +389,8 @@ class Trainer:
                     and step % eval_every == 0
                 ):
                     eval_metrics = self.evaluate(state, eval_iter_fn(),
-                                                 eval_steps)
+                                                 eval_steps,
+                                                 watchdog=watchdog)
                     if metrics_writer is not None:
                         metrics_writer.write(
                             {"step": step, **{f"eval_{k}": v
@@ -400,12 +411,17 @@ class Trainer:
                 close()
 
     def evaluate(self, state: TrainState, eval_iter: Iterator[Batch],
-                 max_steps: int = 0) -> Dict[str, float]:
+                 max_steps: int = 0, watchdog=None) -> Dict[str, float]:
         """Weighted cross-batch aggregation: each batch's metrics carry
         their normalizer (``eval_weight``, or a per-metric
         ``<name>__weight``), so the result is the exact full-set metric —
         not a mean of batch means, which is biased whenever batches have
-        unequal effective weights (padded eval tails, per-token metrics)."""
+        unequal effective weights (padded eval tails, per-token metrics).
+
+        ``watchdog``: beaten after every realized eval batch (each
+        device_get proves device-side progress), so an eval pass longer
+        than ``hang_timeout_s`` doesn't kill a healthy run — the operator
+        budget only has to cover ONE eval batch, not the whole pass."""
         totals: Dict[str, float] = {}
         wsums: Dict[str, float] = {}
         examples = 0.0
@@ -417,6 +433,8 @@ class Trainer:
             metrics = {k: float(v) for k, v in
                        jax.device_get(self.eval_step(state, dev_batch))
                        .items()}
+            if watchdog is not None:
+                watchdog.beat()
             default_w = metrics.pop("eval_weight", float(eb))
             examples += default_w
             for k, v in metrics.items():
