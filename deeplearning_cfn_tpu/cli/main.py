@@ -582,6 +582,27 @@ def _cmd_data_prepare_text(args) -> int:
     return 0
 
 
+def _cmd_data_prepare_coco(args) -> int:
+    from ..data.coco import prepare_coco
+
+    try:
+        info = prepare_coco(args.annotations, args.images, args.out,
+                            args.split, image_size=args.image_size,
+                            max_boxes=args.max_boxes, limit=args.limit)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    print(f"[dlcfn-tpu] wrote {info['images']} images / {info['objects']} "
+          f"objects to {args.out}/{args.split}.npz (skipped "
+          f"{info['skipped_crowd']} crowds, dropped "
+          f"{info['dropped_over_max']} over max-boxes); train with: "
+          f"--preset maskrcnn_coco data.data_dir={args.out} "
+          f"data.synthetic=false data.image_size={info['image_size']} "
+          f"model.kwargs.image_size={info['image_size']} "
+          f"data.max_boxes={info['max_boxes']}")
+    return 0
+
+
 def _cmd_data_prepare_wikipedia(args) -> int:
     from ..data.text import prepare_mlm_text
 
@@ -844,6 +865,22 @@ def build_parser() -> argparse.ArgumentParser:
     dt.add_argument("--seq-len", type=int, default=1024)
     dt.add_argument("--eval-fraction", type=float, default=0.05)
     dt.set_defaults(fn=_cmd_data_prepare_text)
+
+    dc = dsub.add_parser(
+        "prepare-coco",
+        help="COCO instances_*.json + image dir → the detection npz "
+             "contract (boxes, labels, box-aligned 28×28 masks); run per "
+             "split")
+    dc.add_argument("--annotations", required=True,
+                    help="instances_train2017.json-style file")
+    dc.add_argument("--images", required=True, help="image directory")
+    dc.add_argument("--out", required=True, help="output directory")
+    dc.add_argument("--split", required=True, choices=["train", "eval"])
+    dc.add_argument("--image-size", type=int, default=1024)
+    dc.add_argument("--max-boxes", type=int, default=100)
+    dc.add_argument("--limit", type=int, default=0,
+                    help="stop after N images (smoke tests)")
+    dc.set_defaults(fn=_cmd_data_prepare_coco)
 
     dw = dsub.add_parser(
         "prepare-wikipedia",

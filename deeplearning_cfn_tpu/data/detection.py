@@ -94,7 +94,12 @@ def _load_npz(data_dir: str, split: str) -> ArraySource:
         missing = [k for k in _KEYS if k not in z]
         if missing:
             raise KeyError(f"{path} missing keys {missing}")
-        return ArraySource({k: np.asarray(z[k]) for k in _KEYS})
+        arrays = {k: np.asarray(z[k]) for k in _KEYS}
+    # data prepare-coco stores images uint8 (4x smaller on disk); the batch
+    # contract is f32 in [0, 1].
+    if arrays["image"].dtype == np.uint8:
+        arrays["image"] = arrays["image"].astype(np.float32) / 255.0
+    return ArraySource(arrays)
 
 
 def build_detection_source(cfg: DataConfig, train: bool,
