@@ -126,6 +126,11 @@ class TrainConfig:
     # MLM, NMT padding — it reweights exactly like per-GPU averaging did).
     # BatchNorm sees microbatch statistics sequentially.
     grad_accum_steps: int = 1
+    # Microbatch loop lowering: "scan" (O(1) compile + strict sequential
+    # memory — the TPU choice), "unroll" (straight-line bodies), or "auto"
+    # (unroll on CPU, where XLA executes convs inside loop bodies ~10x
+    # slower than straight-line — measured r04; scan elsewhere).
+    grad_accum_unroll: str = "auto"
 
 
 @dataclasses.dataclass

@@ -1,0 +1,141 @@
+"""Large-batch recipe validation (r03 verdict, Next #3): the cheapest
+available de-risking of the north-star claims. The 75.9%-top-1 recipe risk
+is LARS + warmup at pod-scale global batches (SURVEY §8 hard-part #3) —
+untestable at pod scale here, but its failure mode (trust-ratio/warmup
+mis-tuned → large-batch training stalls while small-batch converges) is
+fully visible at CPU scale through gradient accumulation, which emulates
+the device count (trainer.py's documented DP-equivalent averaging).
+
+Two checks, both slow-marked:
+- CIFAR ResNet-20: LARS at effective batch 1024 (accum 8) + warmup must
+  optimize comparably to the small-batch momentum baseline in 8x fewer
+  steps.
+- BERT-tiny: LAMB at effective batch 256 (accum 8) must match the
+  small-batch AdamW loss-curve drop (the BERT-recipe analogue).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    MeshConfig,
+    ModelConfig,
+    OptimizerConfig,
+    ScheduleConfig,
+    TrainConfig,
+)
+from deeplearning_cfn_tpu.data import build_pipeline
+from deeplearning_cfn_tpu.parallel.mesh import build_mesh, local_batch_size
+from deeplearning_cfn_tpu.train import create_train_state
+from deeplearning_cfn_tpu.train.optim import build_optimizer, build_schedule
+from deeplearning_cfn_tpu.train.task import build_task
+from deeplearning_cfn_tpu.train.trainer import Trainer
+
+
+def _train(cfg, steps):
+    """Run ``steps`` train steps; return (first_loss, last_metrics)."""
+    mesh = build_mesh(cfg.mesh)
+    task = build_task(cfg)
+    tx = build_optimizer(
+        cfg.optimizer,
+        build_schedule(cfg.schedule, steps, cfg.train.global_batch, 0))
+    state = create_train_state(
+        jax.random.PRNGKey(0), task.init, tx, mesh,
+        param_rules=getattr(task, "param_rules", ()))
+    trainer = Trainer(cfg, task.loss_fn, tx, mesh=mesh, donate=False)
+    pipe = build_pipeline(cfg.data,
+                          local_batch_size(cfg.train.global_batch, mesh),
+                          cfg.model.num_classes, seed=0, train=True)
+    it = pipe.epochs()
+    first = None
+    m = {}
+    for _ in range(steps):
+        state, m = trainer.train_step(
+            state, trainer.device_batch(next(it)), jax.random.PRNGKey(1))
+        if first is None:
+            first = float(m["loss"])
+    return first, {k: float(v) for k, v in jax.device_get(m).items()}
+
+
+def _cifar_cfg(gb, accum, opt, sched):
+    return ExperimentConfig(
+        model=ModelConfig(name="resnet20", num_classes=10),
+        data=DataConfig(name="cifar10", image_size=32,
+                        num_train_examples=2048, prefetch=0),
+        train=TrainConfig(global_batch=gb, grad_accum_steps=accum,
+                          dtype="float32"),
+        optimizer=opt, schedule=sched, mesh=MeshConfig(data=-1))
+
+
+@pytest.mark.slow
+def test_lars_large_accum_matches_small_batch_momentum(devices):
+    """LARS + warmup at effective batch 1024 (16x the baseline's 64,
+    emulated via accum 8 — the pod-device-count emulation) must optimize
+    the same task to comparable train accuracy in 8x fewer steps. A
+    mis-tuned trust ratio or missing warmup fails exactly this check —
+    the small-scale shadow of the 75.9% recipe risk."""
+    base_first, base = _train(
+        _cifar_cfg(64, 1,
+                   OptimizerConfig(name="momentum", momentum=0.9,
+                                   weight_decay=1e-4),
+                   ScheduleConfig(name="cosine", base_lr=0.1,
+                                  warmup_steps=0)),
+        steps=160)
+    # The baseline must itself converge hard, or the comparison is vacuous.
+    assert base["loss"] < 0.15 and base["accuracy"] > 0.95, base
+
+    lars_first, lars = _train(
+        _cifar_cfg(1024, 8,
+                   OptimizerConfig(name="lars", momentum=0.9,
+                                   weight_decay=1e-4),
+                   ScheduleConfig(name="cosine", base_lr=5.0,
+                                  warmup_steps=4)),
+        steps=20)
+    assert np.isfinite(lars["loss"]), "LARS diverged at large batch"
+    # Tuned r04 reference point: loss 0.82 / acc 0.80 at these settings.
+    # Thresholds leave noise margin while still failing a broken recipe
+    # (an untuned run at the same budget sits at loss ~2.2 / acc ~0.14).
+    assert lars["loss"] < 1.4, (lars_first, lars)
+    assert lars["accuracy"] > base["accuracy"] - 0.35, (base, lars)
+
+
+def _bert_cfg(gb, accum, opt, sched):
+    return ExperimentConfig(
+        model=ModelConfig(name="bert_tiny", num_classes=2,
+                          kwargs=dict(vocab_size=64, hidden_size=32,
+                                      num_layers=2, num_heads=2,
+                                      mlp_dim=64, max_len=32)),
+        data=DataConfig(name="wikipedia_mlm", seq_len=32, vocab_size=64,
+                        num_train_examples=2048, prefetch=0),
+        train=TrainConfig(global_batch=gb, grad_accum_steps=accum,
+                          dtype="float32"),
+        optimizer=opt, schedule=sched, mesh=MeshConfig(data=-1))
+
+
+@pytest.mark.slow
+def test_lamb_large_accum_matches_adamw_loss_curve(devices):
+    """The BERT-recipe analogue: LAMB at effective batch 256 (accum 8)
+    must reproduce a comparable MLM loss-curve drop to the small-batch
+    AdamW baseline (r04 tuning: adamw 4.81->3.95, lamb 4.90->4.10)."""
+    a_first, a = _train(
+        _bert_cfg(32, 1,
+                  OptimizerConfig(name="adamw", weight_decay=0.01),
+                  ScheduleConfig(name="cosine", base_lr=3e-3,
+                                 warmup_steps=15)),
+        steps=120)
+    adamw_drop = a_first - a["loss"]
+    assert adamw_drop > 0.5, (a_first, a)
+
+    l_first, l = _train(
+        _bert_cfg(256, 8,
+                  OptimizerConfig(name="lamb", weight_decay=0.01),
+                  ScheduleConfig(name="cosine", base_lr=2e-2,
+                                 warmup_steps=10)),
+        steps=80)
+    lamb_drop = l_first - l["loss"]
+    assert np.isfinite(l["loss"]), "LAMB diverged at large batch"
+    assert lamb_drop > 0.6 * adamw_drop, (
+        f"LAMB large-batch drop {lamb_drop:.3f} vs AdamW {adamw_drop:.3f}")

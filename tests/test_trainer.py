@@ -325,10 +325,15 @@ def test_exact_eval_counts_every_example(tmp_workdir, devices):
                                atol=1e-6)
 
 
-def test_grad_accum_matches_full_batch(devices):
+@pytest.mark.parametrize("unroll_mode", ["scan", "unroll"])
+def test_grad_accum_matches_full_batch(devices, unroll_mode):
     """grad_accum_steps=k must give exactly the full-batch update for an
     unweighted mean loss with no BN: mean of k equal-size microbatch
-    gradients == the global-batch gradient, and the optimizer runs once."""
+    gradients == the global-batch gradient, and the optimizer runs once.
+
+    Parametrized over BOTH lowerings: 'auto' unrolls on the CPU test
+    backend, so without the explicit 'scan' leg the rolled (unroll=1)
+    path production TPU runs use would have zero coverage."""
     from deeplearning_cfn_tpu.config import MeshConfig
     import optax
 
@@ -351,6 +356,7 @@ def test_grad_accum_matches_full_batch(devices):
     results = {}
     for accum in (1, 4):
         cfg.train.grad_accum_steps = accum
+        cfg.train.grad_accum_unroll = unroll_mode
         state = create_train_state(jax.random.PRNGKey(0), init_fn, tx, mesh)
         trainer = Trainer(cfg, loss_fn, tx, mesh=mesh)
         batch = trainer.device_batch({"x": x, "y": y})
