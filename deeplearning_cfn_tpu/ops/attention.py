@@ -16,7 +16,9 @@ SURVEY.md §3.3 "cuDNN / framework kernels"). Design:
   the same grid-accumulation structure — the forward saves only O and the
   per-row logsumexp, the backward recomputes P per block, so training
   memory is O(S) too (bias-free path).
-- ``fused_attention``: public entry — dispatches to the kernels on TPU,
+- ``fused_attention``: public entry — on TPU dispatches to the kernels,
+  except the hardware-measured short-sequence window (Sk < 1024, backward
+  intermediate under cap) where XLA's own fused attention is faster;
   reference elsewhere. With a bias, the backward falls back to the
   reference VJP (a trainable bias's cotangent is [Sq,Sk]-shaped anyway).
 
@@ -566,6 +568,29 @@ def _bwd(causal, sm_scale, use_pallas, interpret, res, g):
 _fused_attention.defvjp(_fwd, _bwd)
 
 
+# Auto-dispatch crossover, measured on hardware in r03 (BASELINE.md kernel
+# table, v5e): XLA's own fused attention beat the flash kernel at S=512
+# (9.0 ms vs 6.7 ms, 0.74×) while flash won 1.4× at S=2048 and 35× at
+# S=8192 (where XLA spills the [S,S] matrix to HBM). Between the measured
+# points the switch sits at 1024. The XLA path's backward holds 2-3
+# O(B·H·Sq·Sk) f32 buffers live at once (softmax residual + dp/dlogits),
+# so eligibility is capped on ONE such buffer at 512 MiB — ~1.5 GiB real
+# peak, a safe transient on a 16 GB chip. Above it the flash kernel's
+# O(S) memory wins regardless of speed.
+_SHORT_SEQ_THRESHOLD = 1024
+_REF_BWD_BYTES_CAP = 512 << 20
+
+
+def _auto_use_pallas(backend: str, b: int, h: int, sq: int, sk: int) -> bool:
+    """The 'auto' dispatch decision (pure, unit-tested): flash kernel on
+    TPU except in the measured short-sequence window where XLA's fused
+    attention is faster AND its quadratic backward intermediate fits."""
+    if backend != "tpu":
+        return False
+    ref_bytes = b * h * sq * sk * 4
+    return not (sk < _SHORT_SEQ_THRESHOLD and ref_bytes <= _REF_BWD_BYTES_CAP)
+
+
 def fused_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -577,9 +602,12 @@ def fused_attention(
 ) -> jnp.ndarray:
     """Multi-head attention, fused on TPU.
 
-    implementation: 'auto' (pallas on TPU backend, reference otherwise),
-    'pallas', 'reference', or 'interpret' (pallas kernel in interpreter
-    mode — CPU-runnable, used by tests to validate kernel numerics).
+    implementation: 'auto' (on TPU: flash kernel, except the measured
+    short-sequence window — Sk < 1024 with the quadratic backward
+    intermediate under cap — where XLA's own fused attention is faster;
+    off-TPU: reference), 'pallas', 'reference', or 'interpret' (pallas
+    kernel in interpreter mode — CPU-runnable, used by tests to validate
+    kernel numerics).
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError(f"expected [B,H,S,D] inputs, got {q.shape}")
@@ -592,7 +620,9 @@ def fused_attention(
             f"{k.shape[-2]}")
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if implementation == "auto":
-        use_pallas = jax.default_backend() == "tpu"
+        b, h, sq, _ = q.shape
+        use_pallas = _auto_use_pallas(jax.default_backend(), b, h, sq,
+                                      k.shape[-2])
         interpret = False
     elif implementation == "pallas":
         use_pallas, interpret = True, False

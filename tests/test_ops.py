@@ -89,6 +89,31 @@ def test_fused_attention_bfloat16():
         atol=3e-2, rtol=3e-2)
 
 
+def test_auto_dispatch_short_seq_window():
+    """The 'auto' dispatch (ops/attention._auto_use_pallas): flash kernel
+    on TPU except the hardware-measured short-seq window (S<1024) where
+    XLA's fused attention is faster — and only while its quadratic
+    backward intermediate fits the cap (at big batch, flash's O(S)
+    memory wins regardless)."""
+    from deeplearning_cfn_tpu.ops.attention import _auto_use_pallas
+
+    # Never pallas off-TPU.
+    assert _auto_use_pallas("cpu", 8, 12, 512, 512) is False
+    # Short seq on TPU within the memory cap -> XLA path.
+    assert _auto_use_pallas("tpu", 32, 12, 512, 512) is False
+    # Long seq -> flash (the measured 1.4x/35x regime).
+    assert _auto_use_pallas("tpu", 8, 12, 2048, 2048) is True
+    assert _auto_use_pallas("tpu", 2, 12, 8192, 8192) is True
+    # Short seq but the f32 [B,H,Sq,Sk] backward intermediate exceeds
+    # the 512 MiB cap -> flash for memory: 512*12*512*512*4 B = 6.0 GiB.
+    assert _auto_use_pallas("tpu", 512, 12, 512, 512) is True
+    # Near-cap case (60*16*512*512*4 B ≈ 0.94 GiB > 512 MiB): must stay
+    # flash — the XLA backward holds 2-3 such buffers live at once.
+    assert _auto_use_pallas("tpu", 60, 16, 512, 512) is True
+    # The r03 bench shape (32*12*512*512*4 B ≈ 402 MiB) stays eligible.
+    assert _auto_use_pallas("tpu", 32, 12, 512, 512) is False
+
+
 def test_fused_attention_shape_validation():
     with pytest.raises(ValueError, match="B,H,S,D"):
         fused_attention(jnp.zeros((4, 8, 16)), jnp.zeros((4, 8, 16)),
