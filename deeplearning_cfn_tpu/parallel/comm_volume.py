@@ -116,17 +116,12 @@ def comm_volume(compiled) -> Dict[str, Dict[str, int]]:
     return out
 
 
-def compile_train_step(model_name: str, mesh_cfg, *, seq_impl: str = "",
-                       seq_len: int = 32, num_heads: int = 4,
-                       global_batch: int = 16, hidden: int = 32,
-                       num_layers: int = 2):
-    """AOT-compile one real train step (never executed) of a text-family
-    model on ``mesh_cfg`` — the comm_volume input. Tiny shapes, REAL
-    shardings: the collective STRUCTURE is shape-independent."""
+def _compile_step(cfg):
+    """Shared compile recipe: ExperimentConfig → AOT-compiled (never
+    executed) train step on its mesh, with the task's real sharding
+    arguments — the single place the comm_volume compile contract lives."""
     import jax
 
-    from ..config import (DataConfig, ExperimentConfig, ModelConfig,
-                          OptimizerConfig, ScheduleConfig, TrainConfig)
     from ..data import build_pipeline
     from ..parallel.mesh import build_mesh, local_batch_size
     from ..train import create_train_state
@@ -134,11 +129,39 @@ def compile_train_step(model_name: str, mesh_cfg, *, seq_impl: str = "",
     from ..train.task import build_task
     from ..train.trainer import Trainer
 
+    gb = cfg.train.global_batch
+    mesh = build_mesh(cfg.mesh)
+    task = build_task(cfg, mesh=mesh)
+    tx = build_optimizer(cfg.optimizer,
+                         build_schedule(cfg.schedule, 100, gb, 0))
+    state = create_train_state(
+        jax.random.PRNGKey(0), task.init, tx, mesh,
+        param_rules=getattr(task, "param_rules", ()))
+    trainer = Trainer(cfg, task.loss_fn, tx, mesh=mesh, donate=False,
+                      spatial_dim=getattr(task, "spatial_dim", None),
+                      spatial_keys=getattr(task, "spatial_keys", None))
+    pipe = build_pipeline(cfg.data, local_batch_size(gb, mesh),
+                          cfg.model.num_classes, seed=0, train=True)
+    dev_batch = trainer.device_batch(next(iter(pipe.one_epoch(0))))
+    return trainer.train_step.lower(
+        state, dev_batch, jax.random.PRNGKey(1)).compile()
+
+
+def compile_train_step(model_name: str, mesh_cfg, *, seq_impl: str = "",
+                       seq_len: int = 32, num_heads: int = 4,
+                       global_batch: int = 16, hidden: int = 32,
+                       num_layers: int = 2):
+    """AOT-compile one real train step of a text-family model on
+    ``mesh_cfg`` — the comm_volume input. Tiny shapes, REAL shardings:
+    the collective STRUCTURE is shape-independent."""
+    from ..config import (DataConfig, ExperimentConfig, ModelConfig,
+                          OptimizerConfig, ScheduleConfig, TrainConfig)
+
     kwargs = dict(vocab_size=64, hidden_size=hidden, num_layers=num_layers,
                   num_heads=num_heads, mlp_dim=2 * hidden, max_len=seq_len)
     if seq_impl:
         kwargs["seq_impl"] = seq_impl
-    cfg = ExperimentConfig(
+    return _compile_step(ExperimentConfig(
         model=ModelConfig(name=model_name, num_classes=2, kwargs=kwargs),
         data=DataConfig(name="lm_text" if model_name.startswith("gpt")
                         else "wikipedia_mlm",
@@ -148,19 +171,31 @@ def compile_train_step(model_name: str, mesh_cfg, *, seq_impl: str = "",
         optimizer=OptimizerConfig(name="adamw", weight_decay=0.01),
         schedule=ScheduleConfig(name="constant", base_lr=1e-3,
                                 warmup_steps=0),
-        mesh=mesh_cfg)
-    mesh = build_mesh(cfg.mesh)
-    task = build_task(cfg, mesh=mesh)
-    tx = build_optimizer(cfg.optimizer,
-                         build_schedule(cfg.schedule, 100, global_batch, 0))
-    state = create_train_state(jax.random.PRNGKey(0), task.init, tx, mesh,
-                               param_rules=task.param_rules)
-    trainer = Trainer(cfg, task.loss_fn, tx, mesh=mesh, donate=False)
-    pipe = build_pipeline(cfg.data, local_batch_size(global_batch, mesh),
-                          2, seed=0, train=True)
-    dev_batch = trainer.device_batch(next(iter(pipe.one_epoch(0))))
-    return trainer.train_step.lower(
-        state, dev_batch, jax.random.PRNGKey(1)).compile()
+        mesh=mesh_cfg))
+
+
+def compile_detection_step(mesh_cfg, image_size: int = 64,
+                           global_batch: int = 8):
+    """AOT-compile one maskrcnn train step on ``mesh_cfg`` (tiny shapes,
+    real spatial sharding) — quantifies the data+spatial strategy's halo
+    exchanges, which appear as collective-permutes on the 'spatial' axis."""
+    from ..config import (DataConfig, ExperimentConfig, ModelConfig,
+                          OptimizerConfig, ScheduleConfig, TrainConfig)
+
+    return _compile_step(ExperimentConfig(
+        model=ModelConfig(
+            name="maskrcnn_resnet50", num_classes=7,
+            kwargs=dict(image_size=image_size, pre_nms_topk=64,
+                        post_nms_topk=16, num_mask_rois=4,
+                        anchor_scale=4.0)),
+        data=DataConfig(name="coco", image_size=image_size,
+                        num_train_examples=global_batch, max_boxes=4,
+                        prefetch=0),
+        train=TrainConfig(global_batch=global_batch, dtype="float32"),
+        optimizer=OptimizerConfig(name="momentum", momentum=0.9),
+        schedule=ScheduleConfig(name="constant", base_lr=0.01,
+                                warmup_steps=0),
+        mesh=mesh_cfg))
 
 
 def main() -> None:
@@ -185,6 +220,15 @@ def main() -> None:
         print(json.dumps({
             "model": model, "seq_impl": impl,
             "mesh": {"data": mesh_cfg.data, "seq": mesh_cfg.seq},
+            **{k: v for k, v in vol.items() if v["count"]},
+        }), flush=True)
+    # The data+spatial strategy (the spec's one beyond-DP requirement):
+    # conv halo exchanges over 'spatial' vs the pure-DP contrast.
+    for mesh_cfg in (MeshConfig(data=4, spatial=2), MeshConfig(data=8)):
+        vol = comm_volume(compile_detection_step(mesh_cfg))
+        print(json.dumps({
+            "model": "maskrcnn_resnet50",
+            "mesh": {"data": mesh_cfg.data, "spatial": mesh_cfg.spatial},
             **{k: v for k, v in vol.items() if v["count"]},
         }), flush=True)
 

@@ -5,6 +5,7 @@ Next #9 — the table a pod profile is checked against)."""
 from deeplearning_cfn_tpu.config import MeshConfig
 from deeplearning_cfn_tpu.parallel.comm_volume import (
     comm_volume,
+    compile_detection_step,
     compile_train_step,
 )
 
@@ -73,3 +74,16 @@ def test_seq_parallel_comm_structure(devices):
     # Grad all-reduce bytes must cover the full param tuple (not just the
     # loss scalar — the r04 parser bug made it 4 bytes).
     assert dp["all-reduce"]["bytes"] > 50_000
+
+
+def test_spatial_shard_halo_structure(devices):
+    """The data+spatial detection step (SURVEY §3.2's one beyond-DP
+    requirement) must move conv halos over 'spatial' — visible as
+    collective-permute/all-gather traffic that the pure-DP compile of the
+    same model does not have."""
+    sp = comm_volume(compile_detection_step(MeshConfig(data=4, spatial=2)))
+    dp = comm_volume(compile_detection_step(MeshConfig(data=8)))
+    sp_moves = sp["collective-permute"]["count"] + sp["all-gather"]["count"]
+    dp_moves = dp["collective-permute"]["count"] + dp["all-gather"]["count"]
+    assert sp_moves > dp_moves, (sp, dp)
+    assert sp["total"]["bytes"] > dp["total"]["bytes"], (sp, dp)
