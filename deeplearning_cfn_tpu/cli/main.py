@@ -594,7 +594,8 @@ def _cmd_data_prepare_coco(args) -> int:
         return 1
     print(f"[dlcfn-tpu] wrote {info['images']} images / {info['objects']} "
           f"objects to {args.out}/{args.split}.npz (skipped "
-          f"{info['skipped_crowd']} crowds, dropped "
+          f"{info['skipped_crowd']} crowds + "
+          f"{info['skipped_degenerate']} degenerate, dropped "
           f"{info['dropped_over_max']} over max-boxes); train with: "
           f"--preset maskrcnn_coco data.data_dir={args.out} "
           f"data.synthetic=false data.image_size={info['image_size']} "
