@@ -155,9 +155,12 @@ class MaskHead(nn.Module):
     Checkpoint compatibility: this rework (round 4) renamed the parameter
     ``deconv`` (ConvTranspose kernel [2,2,C,Cout]) to ``upsample`` (Dense
     kernel [C, 4·Cout]); detection checkpoints from before it need a
-    one-time convert:
-    ``W_dense = W_convT.transpose(2, 0, 1, 3).reshape(C, 4 * Cout)``
-    (the (a, b, out) ordering matches the depth-to-space reshape below).
+    one-time convert via :func:`convert_deconv_to_upsample`:
+    ``W_dense = W_convT[::-1, ::-1].transpose(2, 0, 1, 3).reshape(C, 4*Cout)``.
+    The spatial flip is required because flax ConvTranspose with
+    kernel == stride == 2 and SAME padding writes kernel tap (a, b) to
+    output offset (1-a, 1-b); without it every 2×2 block comes out
+    spatially swapped (pinned exactly in tests/test_detection.py).
     """
 
     num_classes: int
@@ -186,6 +189,24 @@ class MaskHead(nn.Module):
         x = nn.Conv(self.num_classes, (1, 1), dtype=jnp.float32,
                     name="mask_logits")(x)
         return x.reshape(b, n, 2 * s, 2 * s, self.num_classes)
+
+
+def convert_deconv_to_upsample(w_convt):
+    """Convert a pre-round-4 MaskHead ``deconv`` ConvTranspose kernel
+    ([2, 2, C, Cout]) to the equivalent ``upsample`` Dense kernel
+    ([C, 4·Cout]).
+
+    flax ConvTranspose with kernel == stride == 2, SAME padding places
+    kernel tap (a, b) at output offset (1-a, 1-b) within each 2×2 block,
+    so the taps must be spatially flipped before flattening into the
+    (a, b, out)-ordered Dense columns that MaskHead's depth-to-space
+    reshape expects. Correctness is pinned by
+    tests/test_detection.py::test_deconv_to_upsample_conversion.
+    """
+    k_h, k_w, c, c_out = w_convt.shape
+    if (k_h, k_w) != (2, 2):
+        raise ValueError(f"expected a 2x2 ConvTranspose kernel, got {w_convt.shape}")
+    return w_convt[::-1, ::-1].transpose(2, 0, 1, 3).reshape(c, 4 * c_out)
 
 
 class MaskRCNN(nn.Module):

@@ -277,6 +277,45 @@ def test_detect_one_postprocessing():
     np.testing.assert_allclose(boxes[2], props[3])
 
 
+def test_deconv_to_upsample_conversion():
+    """Pin the pre-round-4 checkpoint conversion: a 2×2/stride-2
+    SAME ConvTranspose and Dense(converted weights) + MaskHead's
+    depth-to-space must agree to f32 rounding. flax ConvTranspose puts
+    kernel tap (a, b) at output offset (1-a, 1-b), so the conversion must
+    flip both spatial axes — the unflipped formula swaps every 2×2 block
+    (ADVICE r4)."""
+    import flax.linen as nn
+
+    from deeplearning_cfn_tpu.models.maskrcnn import convert_deconv_to_upsample
+
+    c, c_out = 5, 7
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 3, 3, c)), jnp.float32)
+    w_convt = jnp.asarray(rng.normal(size=(2, 2, c, c_out)), jnp.float32)
+
+    deconv = nn.ConvTranspose(c_out, (2, 2), strides=(2, 2), padding="SAME",
+                              use_bias=False)
+    ref = deconv.apply({"params": {"kernel": w_convt}}, x)
+
+    w_dense = convert_deconv_to_upsample(np.asarray(w_convt))
+    y = x @ jnp.asarray(w_dense)  # [B, s, s, 4*Cout]
+    b, s = x.shape[0], x.shape[1]
+    y = y.reshape(b, s, s, 2, 2, c_out)
+    y = y.transpose(0, 1, 3, 2, 4, 5).reshape(b, 2 * s, 2 * s, c_out)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-6)
+
+    # The unflipped formula must NOT match — guards against the doc bug
+    # silently coming back.
+    w_bad = np.asarray(w_convt).transpose(2, 0, 1, 3).reshape(c, 4 * c_out)
+    y_bad = x @ jnp.asarray(w_bad)
+    y_bad = y_bad.reshape(b, s, s, 2, 2, c_out)
+    y_bad = y_bad.transpose(0, 1, 3, 2, 4, 5).reshape(b, 2 * s, 2 * s, c_out)
+    assert np.abs(np.asarray(y_bad) - np.asarray(ref)).max() > 0.1
+
+    with pytest.raises(ValueError):
+        convert_deconv_to_upsample(np.zeros((3, 3, c, c_out)))
+
+
 def test_maskrcnn_trains_end_to_end(tmp_workdir):
     """Full pipeline: synthetic COCO → RPN/RoI/mask losses all finite and
     the total improving over a short horizon."""
