@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Round-5 watcher re-armer: the round-4 chip_watch.sh was launched with a
+# poll budget that expires mid-round-5. This waits for the running watcher
+# to exit and, if it exhausted WITHOUT capturing a chip session, arms a
+# fresh chip_watch.sh sized to cover the remainder of the round — so the
+# one-shot measurement session fires no matter when the backend recovers.
+#
+# Usage: tools/rearm_watch.sh [NEW_MAX_POLLS] [POLL_INTERVAL_S]
+
+set -u
+NEW_POLLS="${1:-320}"
+INTERVAL="${2:-90}"
+cd "$(dirname "$0")/.."
+
+# Wait for any running watcher to finish its budget (or its capture).
+while pgrep -f 'chip_watch.sh' > /dev/null 2>&1; do
+  sleep 60
+done
+
+# If a session was already captured, the evidence exists — do not re-arm
+# (chip_session.sh is a one-shot full measurement; a second run would just
+# duplicate it and race git).
+if ls bench_artifacts/chip_session_*.log > /dev/null 2>&1; then
+  echo "$(date -u +%Y%m%dT%H%M%SZ) capture exists; not re-arming" \
+    >> bench_artifacts/rearm.log
+  exit 0
+fi
+
+echo "$(date -u +%Y%m%dT%H%M%SZ) re-arming watcher ($NEW_POLLS polls @ ${INTERVAL}s)" \
+  >> bench_artifacts/rearm.log
+exec bash tools/chip_watch.sh "$NEW_POLLS" "$INTERVAL"
