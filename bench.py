@@ -22,8 +22,9 @@ before jax.devices() returns). Strategy, informed by both failures:
   / backend_init / devices_ok / build / first_compile / warmup / timed /
   done). On failure the LAST marker is parsed into the error field, so a red
   bench localizes the hang to an exact phase instead of reading "timeout".
-- On total failure the contract JSON carries "measured": false (a 0.0 value
-  with rc 0 must not be mistaken for a real measurement).
+- On total failure the contract JSON carries "measured": false and a null
+  value (a numeric 0.0 with rc 0 could be mistaken for a real measurement
+  by anything that aggregates these JSONs).
 
 Do NOT force the CPU backend here: this runs on the real chip.
 
@@ -127,8 +128,35 @@ def _probe_backend() -> tuple[bool, str]:
     return False, f"probe: rc={proc.returncode} after {dt:.1f}s ({tail[0][:200]})"
 
 
+def _finalize_green(record: dict, alive: bool, probe_note: str) -> dict:
+    """Post-process a child record that parsed cleanly.
+
+    Enforces the probe's cpu-fallback verdict: a child that ran on the CPU
+    fallback of a dead accelerator plugin must not ship a green
+    measured=true number against the TPU contract — and like every red
+    record its value/vs_baseline/mfu become null so nothing can aggregate a
+    CPU number as a chip measurement (the raw CPU number is preserved in
+    cpu_fallback_value for diagnosis).
+    """
+    record.setdefault("measured", True)
+    record["probe"] = probe_note
+    cpu_requested = os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+    if not cpu_requested and not alive and record.get("device_kind") == "cpu":
+        record["measured"] = False
+        record["error"] = ("child completed on the CPU fallback of a "
+                           "dead accelerator plugin; " + probe_note)
+        record["cpu_fallback_value"] = record.get("value")
+        record["value"] = None
+        record["vs_baseline"] = None
+        record["mfu"] = None
+    return record
+
+
 def _artifact_path() -> str:
-    d = os.path.join(REPO_ROOT, "bench_artifacts")
+    # Overridable so tests exercising the wrapper don't litter the repo's
+    # committed evidence directory with fake-run logs.
+    d = os.environ.get("DLCFN_BENCH_ARTIFACT_DIR",
+                       os.path.join(REPO_ROOT, "bench_artifacts"))
     os.makedirs(d, exist_ok=True)
     stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
     return os.path.join(d, f"bench_run_{stamp}.log")
@@ -196,19 +224,8 @@ def main() -> None:
         _log(proc.stderr or "(none)")
         record = _parse_record(proc.stdout)
         if proc.returncode == 0 and record is not None:
-            record.setdefault("measured", True)
+            record = _finalize_green(record, alive, probe_note)
             record["artifact"] = rel_artifact
-            record["probe"] = probe_note
-            # Enforce the probe's cpu-fallback verdict: a child that ran on
-            # the CPU fallback of a dead accelerator plugin must not ship a
-            # green measured=true number against the TPU contract.
-            cpu_requested = os.environ.get(
-                "JAX_PLATFORMS", "").startswith("cpu")
-            if (not cpu_requested and not alive
-                    and record.get("device_kind") == "cpu"):
-                record["measured"] = False
-                record["error"] = ("child completed on the CPU fallback of a "
-                                   "dead accelerator plugin; " + probe_note)
             _log(f"==== {'GREEN' if record['measured'] else 'RED'}: "
                  f"{json.dumps(record)} ====")
             print(json.dumps(record))
@@ -220,10 +237,13 @@ def main() -> None:
         )
     red = {
         "metric": METRIC,
-        "value": 0.0,
+        # null, not 0.0: a red record must be unusable as a number — anyone
+        # aggregating BENCH_r*.json must not average in a fake zero (r4
+        # verdict weak #6). "measured": false remains the primary flag.
+        "value": None,
         "unit": UNIT,
-        "vs_baseline": 0.0,
-        "mfu": 0.0,
+        "vs_baseline": None,
+        "mfu": None,
         "measured": False,
         "artifact": rel_artifact,
         "error": " || ".join(errors)[-2000:],

@@ -50,6 +50,13 @@ commit_artifacts() {
 echo "$(STAMP) watcher armed (max $MAX_POLLS polls @ ${INTERVAL}s)" >> "$PROBE_LOG"
 for i in $(seq 1 "$MAX_POLLS"); do
   if timeout 120 python -c "$PROBE" >> "$PROBE_LOG" 2>&1; then
+    # Capture-time one-shot guard: if any watcher instance already ran the
+    # session (two can be armed across a session boundary), do not run a
+    # second one — it would race the first for the chip and for git.
+    if ls "$ART"/chip_session_*.log > /dev/null 2>&1; then
+      echo "$(STAMP) TPU OK (poll $i) but a session capture already exists — standing down" >> "$PROBE_LOG"
+      exit 0
+    fi
     echo "$(STAMP) TPU OK (poll $i) — launching chip session" >> "$PROBE_LOG"
     SESSION_LOG="$ART/chip_session_$(STAMP).log"
     bash tools/chip_session.sh "$SESSION_LOG"
