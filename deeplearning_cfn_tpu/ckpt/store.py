@@ -212,11 +212,13 @@ class GcsStore(Store):
             raise ValueError(f"no bucket in GCS url {url!r}")
         try:
             from google.cloud import storage  # type: ignore
+            from google.cloud import exceptions as gcs_exceptions  # type: ignore
         except ImportError as e:  # pragma: no cover - env without the lib
             raise ImportError(
                 "gs:// checkpoint paths need the google-cloud-storage "
                 "package; install it or use a mounted/POSIX directory"
             ) from e
+        self._not_found = gcs_exceptions.NotFound
         self._client = storage.Client()
         self._bucket = self._client.bucket(bucket)
         self._prefix = prefix.strip("/")
@@ -229,7 +231,14 @@ class GcsStore(Store):
         self._bucket.blob(self._blob_name(key)).upload_from_string(data)
 
     def get_bytes(self, key: str) -> bytes:
-        return self._bucket.blob(self._blob_name(key)).download_as_bytes()
+        # Translate GCS NotFound into the Store contract's FileNotFoundError
+        # (Posix raises it natively, MemoryObjectStore explicitly) — callers
+        # like restore_or_none key their missing-checkpoint handling on it.
+        try:
+            return self._bucket.blob(self._blob_name(key)).download_as_bytes()
+        except self._not_found as e:
+            raise FileNotFoundError(
+                f"{self.url}: no object for key {key!r}") from e
 
     def exists(self, key: str) -> bool:
         return self._bucket.blob(self._blob_name(key)).exists()

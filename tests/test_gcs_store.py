@@ -1,0 +1,228 @@
+"""GcsStore unit tests against a faked google-cloud-storage client.
+
+Real GCS is unreachable offline, but GcsStore's own logic — url parsing,
+key↔blob-name mapping under a prefix, recursive list, delimiter-based
+one-level list_subdirs, delete_prefix, the NotFound→FileNotFoundError
+contract translation — is pure client choreography, so a dict-backed fake
+client covers it without network. The fake mimics the google API shapes
+GcsStore touches: Client.bucket / Client.list_blobs (with the delimiter
+iterator whose .prefixes only populates after the iterator is drained,
+exactly the real HTTPIterator behavior GcsStore relies on), Bucket.blob,
+Blob.upload_from_string / download_as_bytes / exists / delete, and
+google.cloud.exceptions.NotFound.
+
+GcsStore then runs the same Store-interface suite PosixStore and
+MemoryObjectStore pass (tests/test_checkpoint.py) plus the full two-phase
+checkpoint protocol.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+
+class _FakeNotFound(Exception):
+    pass
+
+
+class _FakeBlob:
+    def __init__(self, objects, name):
+        self._objects = objects
+        self.name = name
+
+    def upload_from_string(self, data):
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        self._objects[self.name] = bytes(data)
+
+    def download_as_bytes(self):
+        try:
+            return self._objects[self.name]
+        except KeyError:
+            raise _FakeNotFound(f"404 blob {self.name!r} not found")
+
+    def exists(self):
+        return self.name in self._objects
+
+    def delete(self):
+        if self.name not in self._objects:
+            raise _FakeNotFound(f"404 blob {self.name!r} not found")
+        del self._objects[self.name]
+
+
+class _FakeListIterator:
+    """Mimics google.api_core.page_iterator.HTTPIterator: ``prefixes`` is
+    empty until the pages have actually been consumed — GcsStore must drain
+    the iterator before reading it (store.py pins that with a list(it))."""
+
+    def __init__(self, blobs, prefixes):
+        self._blobs = blobs
+        self._final_prefixes = prefixes
+        self.prefixes = set()
+
+    def __iter__(self):
+        for b in self._blobs:
+            yield b
+        self.prefixes = set(self._final_prefixes)
+
+
+class _FakeBucket:
+    def __init__(self, objects, name):
+        self._objects = objects
+        self.name = name
+
+    def blob(self, name):
+        return _FakeBlob(self._objects, name)
+
+
+class _FakeClient:
+    # One object namespace shared by every client in the process, like a
+    # real bucket; reset per-test by the fixture.
+    objects = {}
+
+    def bucket(self, name):
+        return _FakeBucket(self.objects, name)
+
+    def list_blobs(self, bucket, prefix="", delimiter=None):
+        names = sorted(n for n in bucket._objects if n.startswith(prefix))
+        if delimiter is None:
+            return _FakeListIterator(
+                [_FakeBlob(bucket._objects, n) for n in names], set())
+        direct, prefixes = [], set()
+        for n in names:
+            rest = n[len(prefix):]
+            if delimiter in rest:
+                prefixes.add(prefix + rest.split(delimiter, 1)[0] + delimiter)
+            else:
+                direct.append(n)
+        return _FakeListIterator(
+            [_FakeBlob(bucket._objects, n) for n in direct], prefixes)
+
+
+@pytest.fixture
+def gcs(monkeypatch):
+    """Install the fake google.cloud.storage modules; returns the shared
+    object dict for white-box assertions on blob names."""
+    fake_storage = types.ModuleType("google.cloud.storage")
+    fake_storage.Client = _FakeClient
+    fake_exceptions = types.ModuleType("google.cloud.exceptions")
+    fake_exceptions.NotFound = _FakeNotFound
+    fake_cloud = types.ModuleType("google.cloud")
+    fake_cloud.storage = fake_storage
+    fake_cloud.exceptions = fake_exceptions
+    if "google" not in sys.modules:
+        monkeypatch.setitem(sys.modules, "google", types.ModuleType("google"))
+    monkeypatch.setitem(sys.modules, "google.cloud", fake_cloud)
+    monkeypatch.setitem(sys.modules, "google.cloud.storage", fake_storage)
+    monkeypatch.setitem(sys.modules, "google.cloud.exceptions",
+                        fake_exceptions)
+    _FakeClient.objects = {}
+    return _FakeClient.objects
+
+
+def _make(url="gs://bkt/ckpts/run1"):
+    from deeplearning_cfn_tpu.ckpt.store import GcsStore
+
+    return GcsStore(url)
+
+
+def test_url_parsing_rejects_bad_urls(gcs):
+    from deeplearning_cfn_tpu.ckpt.store import GcsStore
+
+    with pytest.raises(ValueError):
+        GcsStore("/posix/path")
+    with pytest.raises(ValueError):
+        GcsStore("gs://")
+
+
+def test_key_to_blob_name_mapping(gcs):
+    """Keys map under the url prefix; a bare-bucket url maps identity; the
+    prefix never doubles or drops slashes."""
+    store = _make("gs://bkt/ckpts/run1")
+    store.put_bytes("step_00000001/COMMIT", b"x")
+    assert list(gcs) == ["ckpts/run1/step_00000001/COMMIT"]
+
+    gcs.clear()
+    bare = _make("gs://bkt")
+    bare.put_bytes("a/b.txt", b"y")
+    assert list(gcs) == ["a/b.txt"]
+
+    gcs.clear()
+    slashed = _make("gs://bkt/pre/")  # trailing slash must not double up
+    slashed.put_bytes("k", b"z")
+    assert list(gcs) == ["pre/k"]
+
+
+def test_store_interface_suite(gcs):
+    """The exact interface suite PosixStore/MemoryObjectStore pass
+    (tests/test_checkpoint.py::test_store_interface_posix_and_memory)."""
+    store = _make()
+    store.put_bytes("a/b/c.txt", b"hello")
+    assert store.exists("a/b/c.txt")
+    assert store.get_bytes("a/b/c.txt") == b"hello"
+    store.put_npz("a/x.npz", {"w": np.arange(4.0)})
+    z = store.get_npz("a/x.npz")
+    np.testing.assert_array_equal(z["w"], np.arange(4.0))
+    z.close()
+    assert sorted(store.list("a/")) == ["a/b/c.txt", "a/x.npz"]
+    assert store.list_subdirs("") == ["a"]
+    assert store.list_subdirs("a/") == ["b"]
+    store.delete_prefix("a/b/")
+    assert store.list("a/") == ["a/x.npz"]
+    assert not store.exists("a/b/c.txt")
+
+
+def test_missing_key_raises_filenotfound(gcs):
+    """The Store contract: a missing key is FileNotFoundError, not the
+    google NotFound (restore_or_none and friends key on it)."""
+    store = _make()
+    with pytest.raises(FileNotFoundError):
+        store.get_bytes("nope")
+
+
+def test_list_subdirs_is_one_level(gcs):
+    """Delimiter listing returns immediate children only — deep shard
+    objects must not surface grandchildren as subdirs."""
+    store = _make()
+    store.put_bytes("step_00000001/shards/p0/data.npz", b"x")
+    store.put_bytes("step_00000001/COMMIT", b"x")
+    store.put_bytes("step_00000002/COMMIT", b"x")
+    store.put_bytes("rootfile", b"x")
+    assert store.list_subdirs("") == ["step_00000001", "step_00000002"]
+    assert store.list_subdirs("step_00000001/") == ["shards"]
+    assert store.list_subdirs("step_00000001/shards/") == ["p0"]
+
+
+def test_checkpoint_protocol_against_gcs(gcs, devices):
+    """The full two-phase commit protocol (save → DONE/COMMIT → GC →
+    latest → restore; uncommitted invisible) runs against GcsStore exactly
+    as it does against MemoryObjectStore."""
+    import jax.numpy as jnp
+
+    from deeplearning_cfn_tpu.ckpt.checkpoint import (
+        latest_checkpoint,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    store = _make()
+    state = {"params": {"w": jnp.arange(6.0).reshape(2, 3)},
+             "step": jnp.asarray(0, jnp.int32)}
+    for step in [1, 2, 3]:
+        save_checkpoint(store, step, state, keep=2)
+    assert sorted(
+        int(k.split("/")[0][len("step_"):])
+        for k in store.list("") if k.endswith("/COMMIT")) == [2, 3]
+    assert latest_checkpoint(store) == 3
+
+    target = {"params": {"w": jnp.zeros((2, 3))},
+              "step": jnp.asarray(0, jnp.int32)}
+    restored, step = restore_checkpoint(store, target)
+    assert step == 3
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                  np.arange(6.0).reshape(2, 3))
+
+    store.delete_prefix("step_00000003/COMMIT")
+    assert latest_checkpoint(store) == 2

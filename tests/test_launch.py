@@ -5,7 +5,13 @@ had no equivalent: its Horovod jobs hung on node loss)."""
 import os
 import sys
 
-from deeplearning_cfn_tpu.launch import JobLauncher, LocalTransport
+import pytest
+
+from deeplearning_cfn_tpu.launch import (
+    JobLauncher,
+    LocalTransport,
+    SshTransport,
+)
 from deeplearning_cfn_tpu.runtime.cluster import (
     ClusterSpec,
     ENV_PROCESS_ID,
@@ -111,3 +117,154 @@ def test_restart_budget_exhausted(tmp_path):
     assert not result.success
     assert result.restarts == 1
     assert set(result.exit_codes) == {3}
+
+
+# -- SshTransport through a fake-ssh PATH shim ------------------------------
+#
+# The production multi-host path (the `mpirun -hostfile` replacement,
+# SURVEY.md §4.2) fans out over real `ssh`. These tests intercept the `ssh`
+# binary with a PATH script that records its exact argv (so the option/host/
+# remote-command contract is asserted) and then execs the remote command
+# locally — driving the full launcher watch/restart machinery through
+# SshTransport's quoting, env-export, and cwd plumbing.
+
+_SSH_SHIM = r"""#!/usr/bin/env bash
+# Fake ssh for tests: record argv, then run the remote command locally.
+rec=$(mktemp "$FAKE_SSH_DIR/call_XXXXXX.argv")
+printf '%s\n' "$@" > "$rec"
+# Skip ssh options (value-taking ones consume two args) to find the host.
+while [ $# -gt 0 ]; do
+  case "$1" in
+    -o|-p|-i|-l|-F|-E) shift 2 ;;
+    -*) shift ;;
+    *) break ;;
+  esac
+done
+host="$1"; shift
+exec bash -c "$*"
+"""
+
+
+@pytest.fixture
+def fake_ssh(tmp_path, monkeypatch):
+    """Install the fake `ssh` at the front of PATH; returns the directory
+    where every invocation's argv is recorded."""
+    bindir = tmp_path / "fake_bin"
+    bindir.mkdir()
+    calls = tmp_path / "ssh_calls"
+    calls.mkdir()
+    shim = bindir / "ssh"
+    shim.write_text(_SSH_SHIM)
+    shim.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bindir}{os.pathsep}{os.environ['PATH']}")
+    monkeypatch.setenv("FAKE_SSH_DIR", str(calls))
+    return calls
+
+
+def _recorded_calls(calls_dir):
+    return [p.read_text().splitlines()
+            for p in sorted(calls_dir.iterdir())]
+
+
+def test_ssh_transport_argv_env_quoting_and_cwd(fake_ssh, tmp_path):
+    """One fan-out over SshTransport: the ssh argv carries -tt/BatchMode/
+    host, the per-rank env contract arrives ONLY via the exported remote
+    command string (hostile values survive the quoting), and cwd is applied
+    remotely."""
+    workdir = tmp_path / "remote_cwd"
+    workdir.mkdir()
+    tricky = "sp ace 'quo\"te' $HOME ;&|*"
+    code = (
+        "import os; print('rank', os.environ['%s'], "
+        "'tricky', repr(os.environ['TRICKY']), "
+        "'cwd', os.getcwd())" % ENV_PROCESS_ID
+    )
+    launcher = JobLauncher(transport=SshTransport(), tail_rank0=False)
+    spec = ClusterSpec(hosts=["worker-a", "worker-b"])
+    result = launcher.run(spec, _py(code), str(tmp_path / "logs"),
+                          extra_env={"TRICKY": tricky},
+                          cwd=str(workdir))
+    assert result.success
+
+    for rank, host in enumerate(spec.hosts):
+        log = (tmp_path / "logs" / f"attempt0-host{rank}.log").read_text()
+        assert f"rank {rank}" in log
+        assert f"tricky {tricky!r}" in log  # quoting survived verbatim
+        assert f"cwd {workdir}" in log
+
+    argvs = _recorded_calls(fake_ssh)
+    assert len(argvs) == 2
+    hosts_seen = set()
+    for argv in argvs:
+        assert argv[0] == "-tt"  # remote-teardown-on-kill contract flag
+        assert "BatchMode=yes" in argv
+        assert "StrictHostKeyChecking=accept-new" in argv
+        host, remote = argv[-2], argv[-1]
+        hosts_seen.add(host)
+        assert remote.startswith("export ")  # env rides the command string
+        assert "export TRICKY=" in remote
+        assert f"cd {workdir}" in remote
+    assert hosts_seen == {"worker-a", "worker-b"}
+
+
+def test_ssh_transport_extra_ssh_args_precede_host(fake_ssh, tmp_path):
+    launcher = JobLauncher(
+        transport=SshTransport(ssh_args=["-p", "2222"]), tail_rank0=False)
+    result = launcher.run(ClusterSpec(hosts=["worker-x"]),
+                          _py("print('ok')"), str(tmp_path / "logs"))
+    assert result.success
+    (argv,) = _recorded_calls(fake_ssh)
+    p_at = argv.index("-p")
+    assert argv[p_at + 1] == "2222"
+    assert p_at < argv.index("worker-x")  # options before the host operand
+
+
+def test_ssh_transport_failure_kills_remote_survivors(fake_ssh, tmp_path):
+    """Host death over SSH: the launcher must tear down the surviving
+    remote workers (locally: the whole ssh process group) instead of
+    waiting out their sleep."""
+    import time
+    code = (
+        "import os, sys, time\n"
+        f"rank = int(os.environ['{ENV_PROCESS_ID}'])\n"
+        "sys.exit(1) if rank == 1 else time.sleep(3600)\n"
+    )
+    launcher = JobLauncher(transport=SshTransport(), max_restarts=0,
+                           tail_rank0=False)
+    t0 = time.time()
+    result = launcher.run(ClusterSpec(hosts=["worker-a", "worker-b"]),
+                          _py(code), str(tmp_path / "logs"))
+    assert not result.success
+    assert time.time() - t0 < 30
+    assert result.exit_codes[1] == 1
+
+
+def test_ssh_transport_fault_injection_restart_resumes(fake_ssh, tmp_path):
+    """The full kill-a-host → restart → resume cycle through SshTransport:
+    rank 1 crashes once, the relaunched attempt observes the prior marker
+    and succeeds — the auto-restart contract on the production transport."""
+    marker = tmp_path / "attempt0_rank"
+    code = (
+        "import os, sys\n"
+        f"rank = int(os.environ['{ENV_PROCESS_ID}'])\n"
+        f"marker = r'{marker}' + str(rank)\n"
+        "if not os.path.exists(marker):\n"
+        "    open(marker, 'w').write('x')\n"
+        "    sys.exit(7) if rank == 1 else sys.exit(0)\n"
+        "print('RESUMED rank', rank)\n"
+    )
+    failures = []
+    launcher = JobLauncher(transport=SshTransport(), max_restarts=2,
+                           tail_rank0=False)
+    result = launcher.run(
+        ClusterSpec(hosts=["worker-a", "worker-b"]), _py(code),
+        str(tmp_path / "logs"),
+        on_failure=lambda idx, host: failures.append(idx),
+    )
+    assert result.success
+    assert result.restarts == 1
+    assert failures == [1]
+    log = (tmp_path / "logs" / "attempt1-host1.log").read_text()
+    assert "RESUMED rank 1" in log
+    # Two attempts x two hosts = four ssh fan-outs recorded.
+    assert len(_recorded_calls(fake_ssh)) == 4
