@@ -137,6 +137,10 @@ def _finalize_green(record: dict, alive: bool, probe_note: str) -> dict:
     record its value/vs_baseline/mfu become null so nothing can aggregate a
     CPU number as a chip measurement (the raw CPU number is preserved in
     cpu_fallback_value for diagnosis).
+
+    The null-over-zero rule is not fallback-specific: ANY record the child
+    itself marked measured=false (whatever the reason) gets the same
+    nulling, so no unmeasured number ever survives into the green path.
     """
     record.setdefault("measured", True)
     record["probe"] = probe_note
@@ -146,6 +150,7 @@ def _finalize_green(record: dict, alive: bool, probe_note: str) -> dict:
         record["error"] = ("child completed on the CPU fallback of a "
                            "dead accelerator plugin; " + probe_note)
         record["cpu_fallback_value"] = record.get("value")
+    if record.get("measured") is False:
         record["value"] = None
         record["vs_baseline"] = None
         record["mfu"] = None

@@ -335,6 +335,19 @@ def _train_on_stack(args, cfg: ExperimentConfig) -> int:
 
 
 def _cmd_bench(args) -> int:
+    if getattr(args, "serve", False):
+        if getattr(args, "ops", None) or args.collectives or \
+                getattr(args, "sweep_batches", None):
+            print("[dlcfn-tpu] --serve is its own scenario — don't combine "
+                  "with --ops/--collectives/--sweep-batches",
+                  file=sys.stderr)
+            return 2
+        from ..serve.bench import run_serve_bench
+
+        line = run_serve_bench(num_requests=args.requests_count,
+                               slots=args.slots, beam_size=args.beam_size)
+        print(json.dumps(line))
+        return 0
     if getattr(args, "sweep_batches", None):
         if getattr(args, "ops", None) or args.collectives:
             print("[dlcfn-tpu] --sweep-batches only applies to the "
@@ -391,6 +404,129 @@ def _cmd_bench(args) -> int:
                      global_batch=args.global_batch,
                      include_input=args.with_input)
     print(json.dumps(line))
+    return 0
+
+
+def _cmd_serve(args) -> int:
+    """Offline continuous-batching driver over a trained NMT checkpoint.
+
+    Reads a JSONL request trace (``--requests file.jsonl``, or ``-`` for
+    stdin), feeds it through the serve/ engine's slot table with overload
+    backpressure, and prints one result JSON line per request. Requests are
+    ``{"text": ...}`` (needs ``--vocab``) or ``{"src_ids": [...]}``, with
+    optional ``id``, ``max_new_tokens``, ``beam_size``, ``deadline_s``."""
+    cfg = apply_overrides(get_preset(args.preset), args.overrides)
+    if args.accelerator:
+        cfg.stack.accelerator = args.accelerator
+    if cfg.stack.accelerator == "cpu":
+        from ..runtime.platform import force_cpu_platform
+
+        force_cpu_platform()
+    import numpy as np
+
+    from ..metrics.jsonl import MetricsWriter
+    from ..models.decoding import EOS_ID, strip_special
+    from ..serve import OverloadError
+    from ..serve.loader import load_engine
+
+    try:
+        engine, bpe, at_step = load_engine(
+            cfg, capacity=args.slots, queue_depth=args.queue_depth,
+            default_max_new_tokens=args.max_new_tokens,
+            step=args.step, vocab=args.vocab, allow_init=args.allow_init)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    if at_step == -1:
+        print("[dlcfn-tpu] WARNING: serving RANDOM weights (--allow-init, "
+              "no committed checkpoint) — smoke mode only", file=sys.stderr)
+    else:
+        print(f"[dlcfn-tpu] serving checkpoint step {at_step} "
+              f"({args.slots} slots)", file=sys.stderr)
+
+    if args.requests == "-":
+        lines = [ln for ln in sys.stdin if ln.strip()]
+    else:
+        try:
+            with open(args.requests) as fh:
+                lines = [ln for ln in fh if ln.strip()]
+        except OSError as e:
+            print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+            return 1
+
+    writer = MetricsWriter(args.metrics_path, also_stdout=False) \
+        if args.metrics_path else None
+    submitted = []
+    for lineno, ln in enumerate(lines, 1):
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError as e:
+            print(f"[dlcfn-tpu] ERROR: bad JSON on requests line {lineno}: "
+                  f"{e}", file=sys.stderr)
+            return 1
+        if "src_ids" in rec:
+            src_ids = [int(t) for t in rec["src_ids"]]
+        elif "text" in rec:
+            if bpe is None:
+                print(f"[dlcfn-tpu] ERROR: requests line {lineno} has "
+                      "\"text\" but no --vocab was given", file=sys.stderr)
+                return 1
+            src_ids = bpe.encode(rec["text"]) + [EOS_ID]
+        else:
+            print(f"[dlcfn-tpu] ERROR: requests line {lineno} has neither "
+                  "\"src_ids\" nor \"text\"", file=sys.stderr)
+            return 1
+        kwargs = dict(
+            max_new_tokens=int(rec.get("max_new_tokens",
+                                       args.max_new_tokens)),
+            beam_size=int(rec.get("beam_size", args.beam_size)),
+            request_id=rec.get("id"),
+        )
+        if rec.get("deadline_s") is not None:
+            kwargs["deadline_s"] = float(rec["deadline_s"])
+        while True:
+            try:
+                submitted.append(engine.submit(src_ids, **kwargs).id)
+                break
+            except ValueError as e:
+                # Unplaceable request (source too long, beam too wide):
+                # reject the line, keep serving the rest of the trace.
+                print(f"[dlcfn-tpu] requests line {lineno} rejected: {e}",
+                      file=sys.stderr)
+                break
+            except OverloadError:
+                # Bounded queue full: drain a step, then retry (offline
+                # driver backpressure; an online front-end would 429).
+                if not engine.step():
+                    raise
+        if writer is not None and args.emit_every and \
+                len(submitted) % args.emit_every == 0:
+            engine.metrics.emit(writer)
+    steps = engine.run_until_drained(writer=writer,
+                                     emit_every=args.emit_every)
+    for rid in submitted:
+        req = engine.poll(rid)
+        out = {
+            "id": req.id,
+            "state": req.state.value,
+            "tokens": [int(t) for t in strip_special(req.tokens)],
+            "ttft_s": req.ttft_s,
+            "latency_s": req.latency_s,
+        }
+        if bpe is not None:
+            out["text"] = bpe.decode(np.asarray(
+                strip_special(req.tokens), np.int32))
+        print(json.dumps(out), flush=True)
+    snap = engine.metrics.snapshot()
+    print(f"[dlcfn-tpu] drained in {steps} steps: "
+          f"{snap['serve_completed']} done, "
+          f"{snap['serve_cancelled']} cancelled, "
+          f"{snap['serve_expired']} expired; "
+          f"tokens/sec={snap['serve_tokens_per_sec']}, "
+          f"ttft_p50_s={snap['serve_ttft_p50_s']}, "
+          f"occupancy={snap['serve_slot_occupancy']}", file=sys.stderr)
+    if writer is not None:
+        writer.close()
     return 0
 
 
@@ -775,6 +911,41 @@ def build_parser() -> argparse.ArgumentParser:
                           "training run used")
     gen.set_defaults(fn=_cmd_generate)
 
+    sv = sub.add_parser(
+        "serve",
+        help="continuous-batching inference over a trained NMT checkpoint "
+             "(offline driver: JSONL requests in, completions out)")
+    sv.add_argument("--preset", required=True)
+    sv.add_argument("--accelerator", default="", choices=["", "tpu", "cpu"])
+    sv.add_argument("--requests", required=True,
+                    help="JSONL request trace path, or - for stdin; each "
+                         "line {\"text\": ...} or {\"src_ids\": [...]} plus "
+                         "optional id/max_new_tokens/beam_size/deadline_s")
+    sv.add_argument("--slots", type=int, default=4,
+                    help="slot-table capacity (concurrent KV-cache rows)")
+    sv.add_argument("--queue-depth", type=int, default=64,
+                    help="bounded queue size; beyond it submits are "
+                         "rejected (the driver drains and retries)")
+    sv.add_argument("--max-new-tokens", type=int, default=64)
+    sv.add_argument("--beam-size", type=int, default=1,
+                    help="default beam width for requests that don't set "
+                         "their own (1 = greedy)")
+    sv.add_argument("--vocab", default="",
+                    help="BPE vocab.json — required for \"text\" requests")
+    sv.add_argument("--step", type=int, default=0,
+                    help="committed checkpoint step (0 = latest)")
+    sv.add_argument("--allow-init", action="store_true",
+                    help="serve random weights when no checkpoint exists "
+                         "(smoke/CI mode)")
+    sv.add_argument("--metrics-path", default="",
+                    help="append serve_* metrics records to this JSONL file")
+    sv.add_argument("--emit-every", type=int, default=20,
+                    help="metrics emission period in engine steps")
+    sv.add_argument("overrides", nargs="*",
+                    help="config overrides — at least the workdir the "
+                         "training run used")
+    sv.set_defaults(fn=_cmd_serve)
+
     # introspection ----------------------------------------------------------
     pr = sub.add_parser("presets", help="list training presets")
     pr.set_defaults(fn=_cmd_presets)
@@ -814,6 +985,16 @@ def build_parser() -> argparse.ArgumentParser:
     be.add_argument("--sweep-batches",
                     help="comma-separated global batch sizes to bench in "
                          "sequence (one JSON line each), e.g. 256,512,768")
+    be.add_argument("--serve", action="store_true",
+                    help="run the serving scenario (fixed request trace "
+                         "through the continuous-batching engine) instead "
+                         "of a training-step bench")
+    be.add_argument("--requests-count", type=int, default=16,
+                    help="serving scenario: trace length")
+    be.add_argument("--slots", type=int, default=4,
+                    help="serving scenario: slot-table capacity")
+    be.add_argument("--beam-size", type=int, default=1,
+                    help="serving scenario: beam width (1 = greedy)")
     be.set_defaults(fn=_cmd_bench)
 
     met = sub.add_parser(

@@ -47,6 +47,15 @@ class MultiHeadAttention(nn.Module):
     the KV-cache that turns O(T²) decode recompute into O(T). Create the
     cache by running ``model.init`` on the decode path and keep the
     returned "cache" collection as scan carry (flax's standard pattern).
+
+    ``decode_pos`` (with ``decode=True``) replaces the shared scalar
+    ``cache_index`` with an explicit per-row position vector [B]: row b's
+    K/V land at ``decode_pos[b]`` and row b attends to positions
+    ``<= decode_pos[b]``. The caller owns advancing the positions. This is
+    the continuous-batching mode (serve/engine.py): every cache row can sit
+    at a different depth, so a finished request's rows are recycled —
+    restart a row at position 0 and the step bias hides whatever a prior
+    occupant left above it — without stalling in-flight neighbours.
     """
 
     num_heads: int
@@ -64,7 +73,7 @@ class MultiHeadAttention(nn.Module):
     @nn.compact
     def __call__(self, x, kv=None, bias=None, causal=False,
                  deterministic=True, decode=False,
-                 max_decode_len: int = 0):
+                 max_decode_len: int = 0, decode_pos=None):
         self_attention = kv is None
         kv = x if kv is None else kv
         features = x.shape[-1]
@@ -102,17 +111,33 @@ class MultiHeadAttention(nn.Module):
                                lambda: jnp.zeros((), jnp.int32))
             idx = ci.value
             if is_initialized:
-                ck.value = jax.lax.dynamic_update_slice(
-                    ck.value, k.astype(self.dtype), (0, 0, idx, 0))
-                cv.value = jax.lax.dynamic_update_slice(
-                    cv.value, v.astype(self.dtype), (0, 0, idx, 0))
-                ci.value = idx + 1
-            # Attend only to filled positions (<= idx). The single-query
-            # step is tiny — the jnp reference path, not the Pallas
-            # kernel, is the right tool.
-            step_bias = jnp.where(
-                jnp.arange(max_decode_len) <= idx, 0.0, -1e30
-            )[None, None, None, :].astype(jnp.float32)
+                if decode_pos is None:
+                    ck.value = jax.lax.dynamic_update_slice(
+                        ck.value, k.astype(self.dtype), (0, 0, idx, 0))
+                    cv.value = jax.lax.dynamic_update_slice(
+                        cv.value, v.astype(self.dtype), (0, 0, idx, 0))
+                    ci.value = idx + 1
+                else:
+                    # Per-row write: row b's single-position K/V land at
+                    # decode_pos[b]. cache_index is left untouched — the
+                    # caller (serve/engine.py) owns per-row positions.
+                    rows = jnp.arange(b)
+                    ck.value = ck.value.at[rows, :, decode_pos, :].set(
+                        k[:, :, 0, :].astype(self.dtype))
+                    cv.value = cv.value.at[rows, :, decode_pos, :].set(
+                        v[:, :, 0, :].astype(self.dtype))
+            # Attend only to filled positions (<= the row's position). The
+            # single-query step is tiny — the jnp reference path, not the
+            # Pallas kernel, is the right tool.
+            if decode_pos is None:
+                step_bias = jnp.where(
+                    jnp.arange(max_decode_len) <= idx, 0.0, -1e30
+                )[None, None, None, :].astype(jnp.float32)
+            else:
+                step_bias = jnp.where(
+                    jnp.arange(max_decode_len)[None, :]
+                    <= decode_pos[:, None], 0.0, -1e30
+                )[:, None, None, :].astype(jnp.float32)
             out = fused_attention(q, ck.value, cv.value, bias=step_bias,
                                   causal=False, implementation="reference")
         else:
@@ -173,7 +198,7 @@ class TransformerLayer(nn.Module):
     @nn.compact
     def __call__(self, x, enc=None, self_bias=None, cross_bias=None,
                  causal=False, deterministic=True, decode=False,
-                 max_decode_len: int = 0):
+                 max_decode_len: int = 0, decode_pos=None):
         ln = lambda name: nn.LayerNorm(
             dtype=self.dtype, param_dtype=jnp.float32, name=name)
         attn = lambda name: MultiHeadAttention(
@@ -193,7 +218,7 @@ class TransformerLayer(nn.Module):
             x, lambda y: attn("self_attn")(
                 y, bias=self_bias, causal=causal and not decode,
                 deterministic=deterministic, decode=decode,
-                max_decode_len=max_decode_len),
+                max_decode_len=max_decode_len, decode_pos=decode_pos),
             "self_attn")
         if self.cross_attention:
             if enc is None:

@@ -145,6 +145,31 @@ class TransformerNMT(nn.Module):
         y = self.dec_norm(y)
         return self.embed.logits(y)
 
+    def decode_step_at(self, tgt_id, enc, src_mask, pos):
+        """Single-position decode with PER-ROW positions — the
+        continuous-batching form of :meth:`decode_step`.
+
+        ``pos`` is [B] int32: row b's token ``tgt_id[b]`` sits at position
+        ``pos[b]`` and its K/V land at that cache row's ``pos[b]`` slot
+        (transformer.MultiHeadAttention ``decode_pos``). Rows are fully
+        independent, so a serving engine can hold every in-flight request
+        at a different depth in one fixed-shape batch and restart a
+        finished row at position 0 without touching its neighbours.
+        Numerically identical to :meth:`decode_step` when all rows share
+        one position. Create the cache with ``model.init(...,
+        method=TransformerNMT.decode_step_at)``.
+        """
+        pos_emb = jnp.take(self.embed.tgt_position, pos, axis=0)  # [B, H]
+        y = self.embed.token(tgt_id) + pos_emb[:, None, :]
+        y = self.embed.tgt_norm(y.astype(self.dtype))
+        cross_bias = padding_bias(src_mask)
+        for lyr in self.dec:
+            y = lyr(y, enc=enc, cross_bias=cross_bias, causal=True,
+                    deterministic=True, decode=True,
+                    max_decode_len=self.max_len, decode_pos=pos)
+        y = self.dec_norm(y)
+        return self.embed.logits(y)
+
     def __call__(self, src_ids, src_mask, tgt_in_ids, train: bool = True):
         enc = self.encode(src_ids, src_mask, train=train)
         return self.decode(tgt_in_ids, enc, src_mask, train=train)

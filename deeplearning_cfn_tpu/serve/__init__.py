@@ -1,0 +1,25 @@
+"""serve/ — the inference subsystem: `train → serve`.
+
+Turns a trained checkpoint into a request-serving engine built on the
+KV-cache decoder machinery (models/decoding.py, models/transformer_nmt.py):
+
+- :mod:`.engine` — continuous-batching scheduler over a fixed slot table of
+  per-row KV-cache positions;
+- :mod:`.queue` — bounded request lifecycle (submit/poll/cancel, deadlines,
+  explicit overload rejection);
+- :mod:`.loader` — checkpoint restore + tokenizer binding;
+- :mod:`.metrics` — queue depth / TTFT / tokens-per-sec / slot occupancy
+  through metrics/jsonl.py;
+- :mod:`.bench` — the fixed-trace serving benchmark scenario.
+
+CLI surface: `dlcfn-tpu serve --preset … --requests file.jsonl`.
+"""
+
+from .engine import Engine  # noqa: F401
+from .metrics import ServeMetrics, percentile  # noqa: F401
+from .queue import (  # noqa: F401
+    OverloadError,
+    Request,
+    RequestQueue,
+    RequestState,
+)

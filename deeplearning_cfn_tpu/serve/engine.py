@@ -1,0 +1,376 @@
+"""Continuous-batching inference engine over the KV-cache decoder.
+
+The serving core: a fixed-capacity **slot table** of KV-cache rows driven by
+one jitted single-position decode per step (models/transformer_nmt.py
+``decode_step_at``). Unlike the offline searchers in models/decoding.py —
+which scan a whole batch in lockstep from position 0 to max_len — every row
+here carries its own decode position, so the engine admits queued requests
+into free rows *mid-flight*, evicts rows the moment their request hits EOS /
+budget / deadline, and recycles them for the next request without stalling
+the neighbours. That is continuous batching: the device always sees one
+fixed-shape [capacity, 1] decode step, and the scheduler swaps work in and
+out of rows between steps.
+
+Row recycling needs no cache zeroing: the per-row step bias only exposes
+positions ``<= pos[row]``, so restarting a row at position 0 hides whatever
+a previous occupant wrote above it.
+
+Search modes per request:
+
+- ``beam_size == 1`` — greedy, one row per request; token choice replicates
+  ``decoding.greedy_decode_cached`` (argmax, stop at EOS).
+- ``beam_size == w > 1`` — beam search, ``w`` rows per request (a *slot
+  group*). The per-step candidate selection runs as a tiny jitted top-k
+  identical to ``decoding.beam_decode_cached`` (log-softmax in f32, PAD-only
+  zero-cost continuation for finished beams, flattened w·V top-k), and the
+  surviving beams' cache rows are re-gathered through a [capacity]
+  permutation. Final hypothesis pick uses the same GNMT length norm.
+
+Both modes are parity-tested token-identical against models/decoding.py
+(tests/test_serve.py).
+
+Scheduler invariants (tested):
+- a row is owned by at most one request at a time;
+- admits happen only into free rows, in FIFO submit order (a beam group
+  that doesn't fit blocks later requests — no out-of-order sneak-in);
+- overload surfaces as queue.OverloadError at submit, never silent growth;
+- a cancelled or expired request frees its rows within one step.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.decoding import BOS_ID, EOS_ID, PAD_ID
+from .metrics import ServeMetrics
+from .queue import OverloadError, Request, RequestQueue, RequestState
+
+
+@dataclass
+class _Group:
+    """Host-side bookkeeping for one RUNNING request (1 or beam_size rows)."""
+
+    req: Request
+    rows: List[int]
+    budget: int  # decode-step budget (<= model.max_len)
+    steps: int = 0
+    # Beam-search state (beam_size > 1): replicates beam_decode_cached's
+    # carry. beam_tokens column 0 is BOS, column t+1 the step-t choice.
+    scores: Optional[np.ndarray] = None
+    beam_done: Optional[np.ndarray] = None
+    beam_tokens: Optional[np.ndarray] = None
+    done: bool = False
+
+
+class Engine:
+    """Continuous-batching serving engine for the NMT encoder-decoder.
+
+    ``capacity`` is the number of KV-cache rows (the slot table size);
+    ``max_src_len`` the fixed source padding length every request is encoded
+    at. The engine is host-driven: :meth:`step` runs one decode over all
+    rows and does admission/eviction around it; :meth:`run_until_drained`
+    loops it — the offline driver mode `dlcfn-tpu serve --requests` uses.
+    """
+
+    def __init__(self, model, variables, capacity: int = 4,
+                 max_src_len: int = 0, queue_depth: int = 64,
+                 default_max_new_tokens: int = 64,
+                 length_penalty: float = 0.6,
+                 clock=time.monotonic,
+                 metrics: Optional[ServeMetrics] = None):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self.model = model
+        self.variables = variables
+        self.capacity = capacity
+        self.model_max_len = int(getattr(model, "max_len", 0) or 0)
+        if self.model_max_len <= 0:
+            raise ValueError("model must expose max_len (the KV-cache size)")
+        self.max_src_len = int(max_src_len) if max_src_len else \
+            self.model_max_len
+        self.default_max_new_tokens = min(default_max_new_tokens,
+                                          self.model_max_len)
+        self.length_penalty = length_penalty
+        self._clock = clock
+        self.queue = RequestQueue(max_depth=queue_depth, clock=clock)
+        self.metrics = metrics if metrics is not None \
+            else ServeMetrics(capacity, clock=clock)
+
+        mcls = type(model)
+        self._encode_fn = jax.jit(
+            lambda v, src, mask: model.apply(v, src, mask,
+                                             method=mcls.encode))
+
+        def _step(v, cache, prev, enc, src_mask, pos):
+            logits, mut = model.apply(
+                {**v, "cache": cache}, prev, enc, src_mask, pos,
+                method=mcls.decode_step_at, mutable=["cache"])
+            return logits[:, 0, :].astype(jnp.float32), mut["cache"]
+
+        self._step_fn = jax.jit(_step)
+        self._beam_select_fns: Dict[int, object] = {}
+
+        cap = self.capacity
+
+        def _permute(cache, perm):
+            return jax.tree_util.tree_map(
+                lambda c: c[perm] if getattr(c, "ndim", 0) > 0
+                and c.shape[0] == cap else c, cache)
+
+        self._permute_fn = jax.jit(_permute)
+
+        # Device state. One warmup encode fixes enc's shape/dtype (and
+        # pre-compiles the encoder for the serving shape).
+        s = self.max_src_len
+        dummy_src = jnp.zeros((1, s), jnp.int32)
+        dummy_mask = jnp.zeros((1, s), jnp.int32)
+        enc1 = self._encode_fn(variables, dummy_src, dummy_mask)
+        self._enc = jnp.zeros((cap, s, enc1.shape[-1]), enc1.dtype)
+        self._src_mask = jnp.zeros((cap, s), jnp.int32)
+        self.cache = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((cap, 1), jnp.int32),
+            self._enc, self._src_mask, jnp.zeros((cap,), jnp.int32),
+            method=mcls.decode_step_at)["cache"]
+        # Host-side per-row state.
+        self._prev = np.full((cap,), PAD_ID, np.int32)
+        self._pos = np.zeros((cap,), np.int32)
+        self._row_owner: List[Optional[str]] = [None] * cap
+        self._groups: List[_Group] = []
+
+    # -- client surface ----------------------------------------------------
+
+    def submit(self, src_ids: List[int],
+               max_new_tokens: Optional[int] = None, beam_size: int = 1,
+               deadline_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> Request:
+        """Validate + enqueue. Raises OverloadError when the queue is full,
+        ValueError on requests the engine could never place."""
+        if len(src_ids) > self.max_src_len:
+            raise ValueError(
+                f"source length {len(src_ids)} exceeds the engine's "
+                f"max_src_len {self.max_src_len}")
+        if beam_size > self.capacity:
+            raise ValueError(
+                f"beam_size {beam_size} exceeds the slot capacity "
+                f"{self.capacity} — it could never be admitted")
+        budget = min(max_new_tokens or self.default_max_new_tokens,
+                     self.model_max_len)
+        try:
+            req = self.queue.submit(src_ids, budget, beam_size=beam_size,
+                                    deadline_s=deadline_s,
+                                    request_id=request_id)
+        except OverloadError:
+            self.metrics.record_reject()
+            raise
+        self.metrics.record_submit()
+        return req
+
+    def poll(self, request_id: str) -> Request:
+        return self.queue.poll(request_id)
+
+    def cancel(self, request_id: str) -> bool:
+        return self.queue.cancel(request_id)
+
+    def slot_view(self) -> List[Optional[str]]:
+        """Row → owning request id (None = free). For tests/diagnostics."""
+        return list(self._row_owner)
+
+    @property
+    def active_requests(self) -> int:
+        return len(self._groups)
+
+    @property
+    def active_rows(self) -> int:
+        return sum(1 for o in self._row_owner if o is not None)
+
+    # -- scheduler ---------------------------------------------------------
+
+    def _free_rows(self) -> List[int]:
+        return [r for r in range(self.capacity)
+                if self._row_owner[r] is None]
+
+    def _release(self, group: _Group, state: RequestState,
+                 now: float) -> None:
+        for r in group.rows:
+            self._row_owner[r] = None
+            self._prev[r] = PAD_ID
+            self._pos[r] = 0
+        group.req.state = state
+        group.req.finished_at = now
+        self._groups.remove(group)
+        self.metrics.record_finish(state.value, group.req.latency_s)
+
+    def _finalize_beam(self, group: _Group) -> None:
+        """Best-hypothesis pick, exactly beam_decode_cached's rule: GNMT
+        length norm over non-PAD generated tokens, argmax of score/norm."""
+        gen = group.beam_tokens[:, 1:group.steps + 1]
+        lengths = (gen != PAD_ID).sum(axis=-1).astype(np.float32)
+        norm = ((5.0 + lengths) / 6.0) ** self.length_penalty
+        best = int(np.argmax(group.scores / np.maximum(norm, 1e-6)))
+        group.req.tokens = [int(t) for t in gen[best]]
+
+    def _reap(self, now: float) -> None:
+        """Evict cancelled/expired running requests — their rows are free
+        for this very step's admission ("within one step")."""
+        for g in list(self._groups):
+            if g.req.cancel_requested:
+                if g.req.beam_size > 1:
+                    self._finalize_beam(g)
+                self._release(g, RequestState.CANCELLED, now)
+            elif g.req.deadline is not None and now >= g.req.deadline:
+                if g.req.beam_size > 1:
+                    self._finalize_beam(g)
+                self._release(g, RequestState.EXPIRED, now)
+
+    def _admit(self, now: float) -> None:
+        free = self._free_rows()
+        while free:
+            req = self.queue.pop_ready(now)
+            if req is None:
+                break
+            w = req.beam_size
+            if w > len(free):
+                # FIFO: don't let a smaller later request jump the line.
+                self.queue.requeue_front(req)
+                break
+            rows, free = free[:w], free[w:]
+            src = np.full((1, self.max_src_len), PAD_ID, np.int32)
+            src[0, :len(req.src_ids)] = req.src_ids
+            mask = (src != PAD_ID).astype(np.int32)
+            enc1 = self._encode_fn(self.variables, jnp.asarray(src),
+                                   jnp.asarray(mask))
+            mask_row = jnp.asarray(mask[0])
+            for r in rows:
+                assert self._row_owner[r] is None, \
+                    f"admit into occupied row {r}"
+                self._enc = self._enc.at[r].set(enc1[0])
+                self._src_mask = self._src_mask.at[r].set(mask_row)
+                self._prev[r] = BOS_ID
+                self._pos[r] = 0
+                self._row_owner[r] = req.id
+            group = _Group(req=req, rows=rows, budget=req.max_new_tokens)
+            if w > 1:
+                group.scores = np.full((w,), -1e9, np.float32)
+                group.scores[0] = 0.0
+                group.beam_done = np.zeros((w,), bool)
+                group.beam_tokens = np.full((w, group.budget + 1), PAD_ID,
+                                            np.int32)
+                group.beam_tokens[:, 0] = BOS_ID
+            self._groups.append(group)
+            req.state = RequestState.RUNNING
+            req.admitted_at = now
+            self.metrics.record_admit()
+
+    def _beam_select(self, w: int):
+        """Jitted per-group candidate selection — the same f32 log-softmax
+        + PAD-only continuation + flattened top-k as beam_decode_cached, so
+        tie-breaking and rounding match the offline searcher bit-for-bit."""
+        fn = self._beam_select_fns.get(w)
+        if fn is None:
+            def select(logits_rows, scores, done):
+                logp = jax.nn.log_softmax(logits_rows)
+                v = logp.shape[-1]
+                pad_only = jnp.full((v,), -1e9).at[PAD_ID].set(0.0)
+                logp = jnp.where(done[:, None], pad_only[None, :], logp)
+                cand = scores[:, None] + logp
+                top_scores, top_flat = jax.lax.top_k(cand.reshape(w * v), w)
+                return top_scores, top_flat // v, \
+                    (top_flat % v).astype(jnp.int32)
+
+            fn = jax.jit(select)
+            self._beam_select_fns[w] = fn
+        return fn
+
+    # -- the step ----------------------------------------------------------
+
+    def step(self) -> bool:
+        """One engine tick: reap → admit → decode all rows → per-group
+        search bookkeeping → evict finished. Returns True iff a decode
+        step ran (False = fully idle)."""
+        now = self._clock()
+        self._reap(now)
+        self._admit(now)
+        if not self._groups:
+            return False
+        t0 = self._clock()
+        logits, self.cache = self._step_fn(
+            self.variables, self.cache, jnp.asarray(self._prev[:, None]),
+            self._enc, self._src_mask, jnp.asarray(self._pos))
+        logits = np.asarray(logits)  # [capacity, V] float32
+        rows_active = sum(len(g.rows) for g in self._groups)
+        new_tokens = 0
+        perm = np.arange(self.capacity)
+        perm_needed = False
+        now = self._clock()
+        for g in list(self._groups):
+            new_tokens += len(g.rows)
+            if g.req.beam_size == 1:
+                r = g.rows[0]
+                nxt = int(np.argmax(logits[r]))
+                g.req.tokens.append(nxt)
+                self._prev[r] = nxt
+                self._pos[r] = min(self._pos[r] + 1, self.model_max_len - 1)
+                g.steps += 1
+                if g.req.first_token_at is None:
+                    g.req.first_token_at = now
+                    self.metrics.record_first_token(g.req.ttft_s)
+                if nxt == EOS_ID or g.steps >= g.budget:
+                    self._release(g, RequestState.DONE, now)
+            else:
+                w = g.req.beam_size
+                rows = np.asarray(g.rows)
+                top_scores, beam_idx, tok_idx = self._beam_select(w)(
+                    jnp.asarray(logits[rows]), jnp.asarray(g.scores),
+                    jnp.asarray(g.beam_done))
+                beam_idx = np.asarray(beam_idx)
+                tok_idx = np.asarray(tok_idx)
+                g.scores = np.asarray(top_scores)
+                g.beam_tokens = g.beam_tokens[beam_idx]
+                g.beam_tokens[:, g.steps + 1] = tok_idx
+                g.beam_done = g.beam_done[beam_idx] | (tok_idx == EOS_ID)
+                if not np.array_equal(beam_idx, np.arange(w)):
+                    # Surviving beams inherit their ancestor's cache rows.
+                    for j in range(w):
+                        perm[g.rows[j]] = g.rows[beam_idx[j]]
+                    perm_needed = True
+                for j, r in enumerate(g.rows):
+                    self._prev[r] = int(tok_idx[j])
+                    self._pos[r] = min(self._pos[r] + 1,
+                                       self.model_max_len - 1)
+                g.steps += 1
+                if g.req.first_token_at is None:
+                    g.req.first_token_at = now
+                    self.metrics.record_first_token(g.req.ttft_s)
+                if bool(g.beam_done.all()) or g.steps >= g.budget:
+                    # All-done early exit is parity-safe: finished beams
+                    # only extend with PAD at zero cost, so later steps
+                    # cannot change the normalized-argmax winner.
+                    self._finalize_beam(g)
+                    self._release(g, RequestState.DONE, now)
+        if perm_needed:
+            self.cache = self._permute_fn(self.cache, jnp.asarray(perm))
+        self.metrics.record_step(rows_active, self.queue.depth, new_tokens,
+                                 self._clock() - t0)
+        return True
+
+    def run_until_drained(self, max_steps: int = 1_000_000,
+                          writer=None, emit_every: int = 0) -> int:
+        """Step until queue and slots are empty (the offline driver loop).
+        Optionally emits a metrics record every ``emit_every`` steps and a
+        final one on drain. Returns the number of steps taken."""
+        steps = 0
+        while (self.queue.depth > 0 or self._groups) and steps < max_steps:
+            self.step()
+            steps += 1
+            if writer is not None and emit_every > 0 \
+                    and steps % emit_every == 0:
+                self.metrics.emit(writer)
+        if writer is not None:
+            self.metrics.emit(writer, drained=True)
+        return steps

@@ -1,0 +1,73 @@
+"""Checkpoint → serving Engine: restore params, bind a tokenizer.
+
+The `train → serve` bridge: takes the same ExperimentConfig the training run
+used (preset + overrides), restores the committed checkpoint from the
+experiment's canonical layout (train/run.py ``_workdir_and_ckpt_dir``), and
+hands back a ready :class:`~.engine.Engine`. Tokenization is optional — with
+a ``vocab.json`` (data/bpe.py, from `dlcfn-tpu data prepare-wmt`) requests
+may arrive as text; without one they arrive as raw token ids.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+import jax
+
+from ..ckpt import CheckpointManager, latest_checkpoint
+from ..config import ExperimentConfig, MeshConfig
+from .engine import Engine
+
+
+def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
+                max_src_len: int = 0, queue_depth: int = 64,
+                default_max_new_tokens: int = 64,
+                length_penalty: Optional[float] = None,
+                step: int = 0, vocab: str = "", allow_init: bool = False,
+                clock=time.monotonic) -> Tuple[Engine, object, int]:
+    """Build an Engine from a trained experiment.
+
+    Returns ``(engine, bpe_or_None, checkpoint_step)``;
+    ``checkpoint_step`` is -1 when ``allow_init`` let a missing checkpoint
+    fall back to random init (smoke/bench mode — never a real deployment).
+    """
+    from ..train.run import _workdir_and_ckpt_dir
+    from ..train.task import Seq2SeqTask, build_task
+
+    # serve is a local inference verb, same rationale as `generate`:
+    # collapse every model axis so the engine never demands the training
+    # pod's layout for slot-table batches.
+    cfg.mesh = MeshConfig(data=-1)
+    task = build_task(cfg)
+    if not isinstance(task, Seq2SeqTask):
+        raise ValueError(
+            f"model {cfg.model.name!r} is not an NMT encoder-decoder — "
+            f"serve drives decode_step_at on the transformer_nmt family")
+    variables = task.init(jax.random.PRNGKey(cfg.train.seed))
+    _, ckpt_dir = _workdir_and_ckpt_dir(cfg)
+    if latest_checkpoint(ckpt_dir) is None:
+        if not allow_init:
+            raise FileNotFoundError(
+                f"no committed checkpoint in {ckpt_dir} — train first, or "
+                f"pass allow_init for a random-weights smoke engine")
+        params, at_step = variables["params"], -1
+    else:
+        manager = CheckpointManager(ckpt_dir)
+        restored, at_step = manager.restore_or_none(
+            {"params": variables["params"]}, step=step)
+        params = restored["params"]
+    bpe = None
+    if vocab:
+        from ..data.bpe import Bpe
+
+        bpe = Bpe.load(vocab)
+    engine = Engine(
+        task.model, {"params": params}, capacity=capacity,
+        max_src_len=max_src_len or cfg.data.seq_len,
+        queue_depth=queue_depth,
+        default_max_new_tokens=default_max_new_tokens,
+        length_penalty=cfg.eval.length_penalty
+        if length_penalty is None else length_penalty,
+        clock=clock)
+    return engine, bpe, int(at_step)

@@ -1,0 +1,128 @@
+"""Serving counters, emitted through the existing metrics/jsonl.py writer.
+
+One flat record per emit, every key prefixed ``serve_`` so serving metrics
+coexist with training records in the same JSONL stream (and `dlcfn-tpu
+metrics` keeps ignoring them). The four headline signals the ISSUE names:
+
+- queue depth (admission backlog),
+- time-to-first-token (submit → first generated token),
+- tokens/sec (generated tokens over engine-busy wall time),
+- slot occupancy (active rows / capacity, averaged over steps).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..metrics.jsonl import MetricsWriter
+
+
+def percentile(xs: List[float], q: float) -> Optional[float]:
+    """Nearest-rank-with-interpolation percentile; None on empty input
+    (matching the bench contract's null-over-zero convention)."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    if len(s) == 1:
+        return s[0]
+    rank = (len(s) - 1) * (q / 100.0)
+    lo = int(rank)
+    hi = min(lo + 1, len(s) - 1)
+    frac = rank - lo
+    return s[lo] * (1.0 - frac) + s[hi] * frac
+
+
+class ServeMetrics:
+    """Accumulates engine-side counters; snapshot() flattens them."""
+
+    def __init__(self, capacity: int, clock=time.monotonic):
+        self.capacity = capacity
+        self._clock = clock
+        self.started_at = clock()
+        # Lifecycle counters.
+        self.submitted = 0
+        self.rejected = 0
+        self.admitted = 0
+        self.completed = 0
+        self.cancelled = 0
+        self.expired = 0
+        # Step accounting.
+        self.steps = 0
+        self.tokens_generated = 0
+        self.busy_time_s = 0.0
+        self._occupancy_sum = 0.0
+        self.last_queue_depth = 0
+        # Distributions.
+        self.ttft_s: List[float] = []
+        self.latency_s: List[float] = []
+
+    # -- recording hooks (called by the engine) ----------------------------
+
+    def record_submit(self) -> None:
+        self.submitted += 1
+
+    def record_reject(self) -> None:
+        self.rejected += 1
+
+    def record_admit(self) -> None:
+        self.admitted += 1
+
+    def record_first_token(self, ttft: float) -> None:
+        self.ttft_s.append(ttft)
+
+    def record_finish(self, state: str, latency: Optional[float]) -> None:
+        if state == "done":
+            self.completed += 1
+        elif state == "cancelled":
+            self.cancelled += 1
+        elif state == "expired":
+            self.expired += 1
+        if latency is not None:
+            self.latency_s.append(latency)
+
+    def record_step(self, active_rows: int, queue_depth: int,
+                    new_tokens: int, step_time_s: float) -> None:
+        self.steps += 1
+        self.tokens_generated += new_tokens
+        self.busy_time_s += step_time_s
+        self._occupancy_sum += active_rows / max(self.capacity, 1)
+        self.last_queue_depth = queue_depth
+
+    # -- reporting ---------------------------------------------------------
+
+    @property
+    def tokens_per_sec(self) -> Optional[float]:
+        if self.busy_time_s <= 0:
+            return None
+        return self.tokens_generated / self.busy_time_s
+
+    @property
+    def mean_slot_occupancy(self) -> Optional[float]:
+        if self.steps == 0:
+            return None
+        return self._occupancy_sum / self.steps
+
+    def snapshot(self) -> Dict:
+        return {
+            "serve_submitted": self.submitted,
+            "serve_rejected": self.rejected,
+            "serve_admitted": self.admitted,
+            "serve_completed": self.completed,
+            "serve_cancelled": self.cancelled,
+            "serve_expired": self.expired,
+            "serve_steps": self.steps,
+            "serve_queue_depth": self.last_queue_depth,
+            "serve_slot_capacity": self.capacity,
+            "serve_slot_occupancy": self.mean_slot_occupancy,
+            "serve_tokens_generated": self.tokens_generated,
+            "serve_tokens_per_sec": self.tokens_per_sec,
+            "serve_ttft_p50_s": percentile(self.ttft_s, 50),
+            "serve_ttft_p95_s": percentile(self.ttft_s, 95),
+            "serve_latency_p50_s": percentile(self.latency_s, 50),
+            "serve_latency_p95_s": percentile(self.latency_s, 95),
+            "serve_uptime_s": self._clock() - self.started_at,
+        }
+
+    def emit(self, writer: MetricsWriter, **extra) -> None:
+        writer.write({**self.snapshot(), **extra})

@@ -1,0 +1,196 @@
+"""Request lifecycle for the serving engine (serve/engine.py).
+
+The queue is the engine's admission boundary and the only place a request's
+state machine lives:
+
+    QUEUED → RUNNING → DONE
+       │         ├──→ CANCELLED   (cancel() while queued or running)
+       │         └──→ EXPIRED     (deadline passed; partial output kept)
+       └────────────→ CANCELLED / EXPIRED   (never admitted)
+
+Overload is explicit: the queue is bounded and ``submit`` raises
+:class:`OverloadError` when full — callers see backpressure immediately
+instead of an unbounded queue silently growing until the host dies (the
+north-star "heavy traffic" posture: shed load at the edge, never inside the
+decode loop).
+
+Budgets: every request carries ``max_new_tokens`` (decode-step budget) and
+an optional ``deadline_s`` (wall-clock budget, relative to submit). The
+engine enforces both; the queue only records them.
+
+Thread-safe: a client thread may submit/poll/cancel while the engine thread
+steps. All mutation happens under one lock; the engine takes requests out
+via :meth:`pop_ready`.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    CANCELLED = "cancelled"
+    EXPIRED = "expired"
+
+
+class OverloadError(RuntimeError):
+    """Bounded queue is full — the caller must back off or shed load."""
+
+    def __init__(self, depth: int, max_depth: int):
+        super().__init__(
+            f"request queue full ({depth}/{max_depth}); retry later")
+        self.depth = depth
+        self.max_depth = max_depth
+
+
+@dataclass
+class Request:
+    """One inference request and its observed lifecycle timestamps."""
+
+    id: str
+    src_ids: List[int]
+    max_new_tokens: int
+    beam_size: int = 1
+    deadline: Optional[float] = None  # absolute, engine-clock seconds
+    state: RequestState = RequestState.QUEUED
+    submitted_at: float = 0.0
+    admitted_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    tokens: List[int] = field(default_factory=list)
+    cancel_requested: bool = False
+
+    @property
+    def finished(self) -> bool:
+        return self.state in (RequestState.DONE, RequestState.CANCELLED,
+                              RequestState.EXPIRED)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finished_at is None:
+            return None
+        return self.finished_at - self.submitted_at
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "state": self.state.value,
+            "tokens": list(self.tokens),
+            "ttft_s": self.ttft_s,
+            "latency_s": self.latency_s,
+            "beam_size": self.beam_size,
+        }
+
+
+class RequestQueue:
+    """Bounded FIFO of pending requests + registry of all known requests.
+
+    ``max_depth`` bounds only the QUEUED set (running/finished requests
+    stay pollable without counting against admission capacity).
+    """
+
+    def __init__(self, max_depth: int = 64, clock=time.monotonic):
+        if max_depth <= 0:
+            raise ValueError(f"max_depth must be positive, got {max_depth}")
+        self.max_depth = max_depth
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._pending: List[Request] = []
+        self._by_id: dict = {}
+        self._auto_id = itertools.count()
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def submit(self, src_ids: List[int], max_new_tokens: int,
+               beam_size: int = 1, deadline_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> Request:
+        """Enqueue a request or raise :class:`OverloadError`."""
+        if max_new_tokens <= 0:
+            raise ValueError("max_new_tokens must be positive")
+        if beam_size < 1:
+            raise ValueError("beam_size must be >= 1")
+        if not src_ids:
+            raise ValueError("src_ids must be non-empty")
+        now = self._clock()
+        with self._lock:
+            if len(self._pending) >= self.max_depth:
+                raise OverloadError(len(self._pending), self.max_depth)
+            rid = request_id if request_id is not None \
+                else f"req-{next(self._auto_id)}"
+            if rid in self._by_id:
+                raise ValueError(f"duplicate request id {rid!r}")
+            req = Request(
+                id=rid, src_ids=list(src_ids),
+                max_new_tokens=max_new_tokens, beam_size=beam_size,
+                deadline=None if deadline_s is None else now + deadline_s,
+                submitted_at=now)
+            self._pending.append(req)
+            self._by_id[rid] = req
+            return req
+
+    def pop_ready(self, now: Optional[float] = None) -> Optional[Request]:
+        """Next admissible request (FIFO), skipping — and finalizing —
+        requests that were cancelled or expired while queued. Returns None
+        when nothing is admissible."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            while self._pending:
+                req = self._pending.pop(0)
+                if req.cancel_requested:
+                    req.state = RequestState.CANCELLED
+                    req.finished_at = now
+                    continue
+                if req.deadline is not None and now >= req.deadline:
+                    req.state = RequestState.EXPIRED
+                    req.finished_at = now
+                    continue
+                return req
+            return None
+
+    def requeue_front(self, req: Request) -> None:
+        """Put back a request pop_ready returned but the engine could not
+        place (e.g. a beam group larger than the free-slot count). FIFO
+        order is preserved: the engine stops admitting at the first request
+        that doesn't fit."""
+        with self._lock:
+            self._pending.insert(0, req)
+
+    def poll(self, request_id: str) -> Request:
+        with self._lock:
+            if request_id not in self._by_id:
+                raise KeyError(f"unknown request {request_id!r}")
+            return self._by_id[request_id]
+
+    def cancel(self, request_id: str) -> bool:
+        """Request cancellation. Queued requests finalize at the next
+        pop_ready; running ones are flagged and the engine frees their
+        slots within one step. Returns False if already finished."""
+        with self._lock:
+            req = self._by_id.get(request_id)
+            if req is None:
+                raise KeyError(f"unknown request {request_id!r}")
+            if req.finished:
+                return False
+            req.cancel_requested = True
+            return True
+
+    def all_requests(self) -> List[Request]:
+        with self._lock:
+            return list(self._by_id.values())

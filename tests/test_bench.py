@@ -169,6 +169,26 @@ def test_finalize_green_nulls_cpu_fallback(monkeypatch):
     assert rec["measured"] is True and rec["value"] == 2413.7
 
 
+def test_finalize_green_nulls_any_unmeasured_record(monkeypatch):
+    """Null-over-zero is not fallback-specific: a child that itself said
+    measured=false (for any reason) must not ship numeric value/
+    vs_baseline/mfu through the green path — even on a live accelerator
+    with no CPU fallback in sight."""
+    w = _load_wrapper()
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    rec = w._finalize_green(
+        {"measured": False, "value": 99.9, "vs_baseline": 0.5, "mfu": 0.4,
+         "device_kind": "TPU v5e", "error": "child: warmup diverged"},
+        alive=True, probe_note="probe: tpu alive")
+    assert rec["measured"] is False
+    assert rec["value"] is None
+    assert rec["vs_baseline"] is None
+    assert rec["mfu"] is None
+    # No fake fallback diagnosis was attached — the child's error stands.
+    assert rec["error"] == "child: warmup diverged"
+    assert "cpu_fallback_value" not in rec
+
+
 def test_bench_child_measures_on_cpu():
     """The child process measures a tiny preset on the forced-CPU backend,
     prints the contract JSON with measured=true, and emits every stage

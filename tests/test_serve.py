@@ -1,0 +1,469 @@
+"""serve/ subsystem tests: request lifecycle, scheduler invariants, engine
+parity against the offline searchers, metrics, and the CLI driver.
+
+Parity is the load-bearing guarantee: continuous batching must be a pure
+scheduling optimization, token-identical to models/decoding.py's greedy and
+beam searchers for every request — whatever slot churn happened around it.
+The WMT sliver fixtures (tests/data/wmt_sliver.{de,en}) provide real
+sentences for that check via a BPE vocabulary trained on them.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.data.bpe import NMT_SPECIALS, train_bpe
+from deeplearning_cfn_tpu.models import decoding
+from deeplearning_cfn_tpu.models.transformer_nmt import transformer_nmt_tiny
+from deeplearning_cfn_tpu.serve import (
+    Engine,
+    OverloadError,
+    RequestQueue,
+    RequestState,
+    ServeMetrics,
+    percentile,
+)
+
+DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+
+
+def _sliver_lines(lang):
+    with open(os.path.join(DATA_DIR, f"wmt_sliver.{lang}")) as fh:
+        return [ln.strip() for ln in fh if ln.strip()]
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- queue ------------------------------------------------------------------
+
+
+def test_queue_lifecycle_and_fifo():
+    q = RequestQueue(max_depth=4)
+    a = q.submit([5, 2], 8)
+    b = q.submit([6, 2], 8, beam_size=2)
+    assert a.state is RequestState.QUEUED and q.depth == 2
+    assert q.pop_ready() is a  # FIFO
+    q.requeue_front(a)
+    assert q.pop_ready() is a  # requeue preserves order
+    assert q.pop_ready() is b
+    assert q.poll(a.id) is a
+    with pytest.raises(KeyError):
+        q.poll("nope")
+
+
+def test_queue_overload_is_explicit():
+    q = RequestQueue(max_depth=2)
+    q.submit([5, 2], 4)
+    q.submit([5, 2], 4)
+    with pytest.raises(OverloadError) as ei:
+        q.submit([5, 2], 4)
+    assert ei.value.depth == 2 and ei.value.max_depth == 2
+    # Draining makes room again — bounded, not closed.
+    q.pop_ready()
+    q.submit([5, 2], 4)
+
+
+def test_queue_rejects_bad_requests():
+    q = RequestQueue(max_depth=2)
+    with pytest.raises(ValueError):
+        q.submit([], 4)
+    with pytest.raises(ValueError):
+        q.submit([5, 2], 0)
+    with pytest.raises(ValueError):
+        q.submit([5, 2], 4, beam_size=0)
+    q.submit([5, 2], 4, request_id="dup")
+    with pytest.raises(ValueError):
+        q.submit([5, 2], 4, request_id="dup")
+
+
+def test_queued_cancel_and_deadline_finalize_at_pop():
+    clock = FakeClock()
+    q = RequestQueue(max_depth=4, clock=clock)
+    a = q.submit([5, 2], 4)
+    b = q.submit([6, 2], 4, deadline_s=1.0)
+    c = q.submit([7, 2], 4)
+    assert q.cancel(a.id) is True
+    clock.advance(2.0)  # b's deadline passes while queued
+    assert q.pop_ready() is c  # a and c skipped AND finalized
+    assert a.state is RequestState.CANCELLED and a.finished
+    assert b.state is RequestState.EXPIRED and b.finished
+    assert q.cancel(a.id) is False  # already finished
+
+
+# -- metrics ----------------------------------------------------------------
+
+
+def test_percentile_null_over_zero():
+    assert percentile([], 50) is None
+    assert percentile([3.0], 95) == 3.0
+    assert percentile([1.0, 2.0, 3.0], 50) == 2.0
+
+
+def test_serve_metrics_snapshot_and_emit(tmp_path):
+    from deeplearning_cfn_tpu.metrics.jsonl import MetricsWriter
+
+    clock = FakeClock()
+    m = ServeMetrics(capacity=4, clock=clock)
+    m.record_submit()
+    m.record_admit()
+    m.record_first_token(0.5)
+    m.record_step(2, 3, 2, 0.1)
+    m.record_finish("done", 1.5)
+    snap = m.snapshot()
+    assert snap["serve_submitted"] == 1 and snap["serve_completed"] == 1
+    assert snap["serve_queue_depth"] == 3
+    assert snap["serve_slot_occupancy"] == 0.5
+    assert snap["serve_tokens_per_sec"] == pytest.approx(20.0)
+    assert snap["serve_ttft_p50_s"] == 0.5
+    path = str(tmp_path / "m.jsonl")
+    with MetricsWriter(path, also_stdout=False) as w:
+        m.emit(w, drained=True)
+    rec = json.loads(open(path).read().strip())
+    assert rec["drained"] is True and rec["serve_admitted"] == 1
+
+
+def test_serve_metrics_empty_distributions_are_null():
+    snap = ServeMetrics(capacity=2, clock=FakeClock()).snapshot()
+    assert snap["serve_ttft_p50_s"] is None
+    assert snap["serve_latency_p95_s"] is None
+    assert snap["serve_tokens_per_sec"] is None
+    assert snap["serve_slot_occupancy"] is None
+
+
+# -- engine: shared tiny model ----------------------------------------------
+
+SCHED_VOCAB = 64
+SCHED_SRC_LEN = 8
+
+
+@pytest.fixture(scope="module")
+def sched_model():
+    model = transformer_nmt_tiny(vocab_size=SCHED_VOCAB, hidden_size=32,
+                                 num_layers=1, num_heads=2, mlp_dim=64,
+                                 max_len=32)
+    variables = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, SCHED_SRC_LEN), np.int32),
+        np.ones((1, SCHED_SRC_LEN), np.int32),
+        np.zeros((1, SCHED_SRC_LEN), np.int32), train=False)
+    return model, {"params": variables["params"]}
+
+
+def _mk_engine(sched_model, clock=None, **kw):
+    model, variables = sched_model
+    kw.setdefault("capacity", 2)
+    kw.setdefault("max_src_len", SCHED_SRC_LEN)
+    if clock is not None:
+        kw["clock"] = clock
+    return Engine(model, variables, **kw)
+
+
+def _src(seed, n=5):
+    rng = np.random.RandomState(seed)
+    return [int(t) for t in rng.randint(3, SCHED_VOCAB, size=n - 1)] + \
+        [decoding.EOS_ID]
+
+
+# -- engine: scheduler invariants -------------------------------------------
+
+
+def test_slot_exclusivity_under_churn(sched_model):
+    """No row ever serves two requests, across a run with constant slot
+    turnover (mixed budgets, more requests than capacity)."""
+    eng = _mk_engine(sched_model, capacity=3, queue_depth=32)
+    reqs = [eng.submit(_src(i), max_new_tokens=2 + i % 4)
+            for i in range(10)]
+    steps = 0
+    while eng.queue.depth > 0 or eng.active_requests:
+        eng.step()
+        steps += 1
+        owners = eng.slot_view()
+        running = {g.req.id: g.rows for g in eng._groups}
+        # Every owned row belongs to exactly the group that claims it.
+        claimed = [r for rows in running.values() for r in rows]
+        assert len(claimed) == len(set(claimed)), "row in two groups"
+        for rid, rows in running.items():
+            assert all(owners[r] == rid for r in rows)
+        for r, owner in enumerate(owners):
+            assert owner is None or r in running[owner]
+        assert steps < 200
+    assert all(eng.poll(r.id).state is RequestState.DONE for r in reqs)
+
+
+def test_admission_is_fifo_and_only_into_free_rows(sched_model):
+    """A beam group that doesn't fit blocks later requests (no sneak-in),
+    and admission happens strictly into free rows."""
+    eng = _mk_engine(sched_model, capacity=2, queue_depth=8)
+    big = eng.submit(_src(1), max_new_tokens=4, beam_size=2)
+    small = eng.submit(_src(2), max_new_tokens=2)
+    eng.step()
+    # The beam group took both rows; small must wait (FIFO would be
+    # violated if it half-admitted or jumped ahead of a later submit).
+    assert eng.poll(big.id).state is RequestState.RUNNING
+    assert eng.poll(small.id).state is RequestState.QUEUED
+    assert eng.active_rows == 2
+    eng.run_until_drained()
+    assert eng.poll(big.id).state is RequestState.DONE
+    assert eng.poll(small.id).state is RequestState.DONE
+
+
+def test_overload_rejection_at_engine_submit(sched_model):
+    eng = _mk_engine(sched_model, queue_depth=2)
+    eng.submit(_src(1), max_new_tokens=2)
+    eng.submit(_src(2), max_new_tokens=2)
+    with pytest.raises(OverloadError):
+        eng.submit(_src(3), max_new_tokens=2)
+    assert eng.metrics.rejected == 1
+    eng.run_until_drained()
+
+
+def test_engine_rejects_unplaceable_requests(sched_model):
+    eng = _mk_engine(sched_model, capacity=2)
+    with pytest.raises(ValueError):
+        eng.submit(_src(1), beam_size=3)  # wider than the slot table
+    with pytest.raises(ValueError):
+        eng.submit([5] * (SCHED_SRC_LEN + 1), max_new_tokens=2)
+
+
+def test_cancel_frees_slot_within_one_step(sched_model):
+    clock = FakeClock()
+    eng = _mk_engine(sched_model, clock=clock, capacity=1)
+    a = eng.submit(_src(1), max_new_tokens=30)
+    eng.step()
+    assert eng.poll(a.id).state is RequestState.RUNNING
+    b = eng.submit(_src(2), max_new_tokens=2)
+    assert eng.cancel(a.id) is True
+    eng.step()  # reap a, admit b, decode — one step
+    assert eng.poll(a.id).state is RequestState.CANCELLED
+    assert eng.poll(b.id).state is RequestState.RUNNING
+    assert eng.slot_view() == [b.id]
+    assert eng.poll(a.id).tokens, "partial output is kept"
+    eng.run_until_drained()
+    assert eng.poll(b.id).state is RequestState.DONE
+
+
+def test_deadline_expires_running_request_within_one_step(sched_model):
+    clock = FakeClock()
+    eng = _mk_engine(sched_model, clock=clock, capacity=1)
+    a = eng.submit(_src(1), max_new_tokens=30, deadline_s=5.0)
+    eng.step()
+    assert eng.poll(a.id).state is RequestState.RUNNING
+    clock.advance(10.0)
+    b = eng.submit(_src(2), max_new_tokens=2)
+    eng.step()
+    assert eng.poll(a.id).state is RequestState.EXPIRED
+    assert eng.slot_view() == [b.id]
+    assert eng.metrics.expired == 1
+    eng.run_until_drained()
+
+
+def test_rows_recycle_without_stalling_neighbours(sched_model):
+    """A short request finishing must not disturb a long in-flight one:
+    the long request's output equals its solo-run output."""
+    eng_solo = _mk_engine(sched_model, capacity=2)
+    long_solo = eng_solo.submit(_src(7), max_new_tokens=12)
+    eng_solo.run_until_drained()
+
+    eng = _mk_engine(sched_model, capacity=2, queue_depth=16)
+    long_req = eng.submit(_src(7), max_new_tokens=12)
+    shorts = [eng.submit(_src(20 + i), max_new_tokens=2) for i in range(4)]
+    eng.run_until_drained()
+    assert eng.poll(long_req.id).tokens == eng_solo.poll(long_solo.id).tokens
+    assert all(eng.poll(s.id).state is RequestState.DONE for s in shorts)
+
+
+# -- engine: parity with models/decoding.py over the sliver fixtures --------
+
+PARITY_SRC_LEN = 20
+PARITY_NEW_TOKENS = 12
+
+
+@pytest.fixture(scope="module")
+def sliver_bpe():
+    lines = _sliver_lines("de") + _sliver_lines("en")
+    return train_bpe(lines, vocab_size=300, specials=NMT_SPECIALS)
+
+
+@pytest.fixture(scope="module")
+def parity_setup(sliver_bpe):
+    model = transformer_nmt_tiny(vocab_size=sliver_bpe.vocab_size,
+                                 hidden_size=32, num_layers=1, num_heads=2,
+                                 mlp_dim=64, max_len=32)
+    variables = model.init(
+        jax.random.PRNGKey(1), np.zeros((1, PARITY_SRC_LEN), np.int32),
+        np.ones((1, PARITY_SRC_LEN), np.int32),
+        np.zeros((1, PARITY_SRC_LEN), np.int32), train=False)
+    variables = {"params": variables["params"]}
+    # Real sliver sentences → BPE ids (+EOS), truncated to the serving
+    # source length, data/text.py's source framing.
+    srcs = []
+    for line in _sliver_lines("de")[:6]:
+        ids = sliver_bpe.encode(line)[:PARITY_SRC_LEN - 1]
+        srcs.append(ids + [decoding.EOS_ID])
+    return model, variables, srcs
+
+
+def _direct_decode(model, variables, src_ids, beam_size):
+    src = np.zeros((1, PARITY_SRC_LEN), np.int32)
+    src[0, :len(src_ids)] = src_ids
+    mask = (src != decoding.PAD_ID).astype(np.int32)
+    if beam_size == 1:
+        out = decoding.greedy_decode_cached(model, variables, src, mask,
+                                            PARITY_NEW_TOKENS)
+        return decoding.strip_special(np.asarray(out[0]))
+    out, _ = decoding.beam_decode_cached(model, variables, src, mask,
+                                         PARITY_NEW_TOKENS,
+                                         beam_size=beam_size)
+    return decoding.strip_special(np.asarray(out[0]))
+
+
+def test_greedy_parity_with_offline_decoder(parity_setup):
+    """Engine output is token-identical to greedy_decode_cached for every
+    sliver sentence, despite slot churn (capacity < request count)."""
+    model, variables, srcs = parity_setup
+    direct = [_direct_decode(model, variables, s, 1) for s in srcs]
+    eng = Engine(model, variables, capacity=2, max_src_len=PARITY_SRC_LEN,
+                 default_max_new_tokens=PARITY_NEW_TOKENS)
+    reqs = [eng.submit(s) for s in srcs]
+    eng.run_until_drained()
+    got = [decoding.strip_special(eng.poll(r.id).tokens) for r in reqs]
+    assert got == direct
+
+
+def test_beam_parity_with_offline_decoder(parity_setup):
+    """Beam groups (2 rows/request) reproduce beam_decode_cached exactly,
+    including the GNMT length-norm final pick and cache-row reordering."""
+    model, variables, srcs = parity_setup
+    direct = [_direct_decode(model, variables, s, 2) for s in srcs]
+    eng = Engine(model, variables, capacity=4, max_src_len=PARITY_SRC_LEN,
+                 default_max_new_tokens=PARITY_NEW_TOKENS)
+    reqs = [eng.submit(s, beam_size=2) for s in srcs]
+    eng.run_until_drained()
+    got = [decoding.strip_special(eng.poll(r.id).tokens) for r in reqs]
+    assert got == direct
+
+
+def test_mixed_greedy_and_beam_parity(parity_setup):
+    """Greedy and beam requests sharing the slot table stay parity-exact —
+    the modes must not interfere through the shared cache."""
+    model, variables, srcs = parity_setup
+    eng = Engine(model, variables, capacity=3, max_src_len=PARITY_SRC_LEN,
+                 default_max_new_tokens=PARITY_NEW_TOKENS)
+    reqs = [eng.submit(s, beam_size=1 + (i % 2))
+            for i, s in enumerate(srcs)]
+    eng.run_until_drained()
+    for i, (r, s) in enumerate(zip(reqs, srcs)):
+        want = _direct_decode(model, variables, s, 1 + (i % 2))
+        assert decoding.strip_special(eng.poll(r.id).tokens) == want
+
+
+# -- CLI + bench ------------------------------------------------------------
+
+CLI_OVERRIDES = [
+    "model.kwargs.hidden_size=32", "model.kwargs.num_layers=1",
+    "model.kwargs.num_heads=2", "model.kwargs.mlp_dim=64",
+    "model.kwargs.max_len=64", "data.seq_len=48",
+]
+
+
+def test_cli_serve_offline_driver(tmp_path, capsys, sliver_bpe):
+    """End-to-end `dlcfn-tpu serve`: restore a committed checkpoint, drive
+    a JSONL trace (text + src_ids requests), emit serve_* metrics."""
+    from deeplearning_cfn_tpu.cli.main import main
+    from deeplearning_cfn_tpu.ckpt import CheckpointManager
+    from deeplearning_cfn_tpu.config import apply_overrides
+    from deeplearning_cfn_tpu.presets import get_preset
+    from deeplearning_cfn_tpu.train.run import _workdir_and_ckpt_dir
+    from deeplearning_cfn_tpu.train.task import build_task
+
+    overrides = CLI_OVERRIDES + [
+        f"model.kwargs.vocab_size={sliver_bpe.vocab_size}",
+        f"workdir={tmp_path}",
+    ]
+    cfg = apply_overrides(get_preset("transformer_nmt_wmt"), overrides)
+    task = build_task(cfg)
+    variables = task.init(jax.random.PRNGKey(3))
+    _, ckpt_dir = _workdir_and_ckpt_dir(cfg)
+    CheckpointManager(ckpt_dir, async_write=False).save(
+        7, {"params": variables["params"]}, force=True)
+
+    vocab_path = str(tmp_path / "vocab.json")
+    sliver_bpe.save(vocab_path)
+    reqs_path = str(tmp_path / "reqs.jsonl")
+    sentence = _sliver_lines("de")[0]
+    with open(reqs_path, "w") as fh:
+        fh.write(json.dumps({"text": sentence, "id": "txt",
+                             "max_new_tokens": 4}) + "\n")
+        fh.write(json.dumps({"src_ids": [5, 9, 2], "id": "raw",
+                             "beam_size": 2}) + "\n")
+        # Unplaceable (source longer than data.seq_len): rejected with a
+        # diagnostic, must not sink the rest of the trace.
+        fh.write(json.dumps({"src_ids": [5] * 60, "id": "toolong"}) + "\n")
+    metrics_path = str(tmp_path / "serve.jsonl")
+    rc = main(["serve", "--preset", "transformer_nmt_wmt",
+               "--accelerator", "cpu", "--requests", reqs_path,
+               "--slots", "2", "--max-new-tokens", "4", "--vocab",
+               vocab_path, "--metrics-path", metrics_path, *overrides])
+    captured = capsys.readouterr()
+    assert rc == 0
+    results = {r["id"]: r
+               for r in map(json.loads, captured.out.strip().splitlines())}
+    assert results["txt"]["state"] == "done"
+    assert results["raw"]["state"] == "done"
+    assert "toolong" not in results
+    assert "line 3 rejected" in captured.err
+    assert "text" in results["txt"]  # BPE-decoded output
+    assert results["txt"]["ttft_s"] is not None
+    # The drained metrics record carries the headline serving signals.
+    records = [json.loads(ln) for ln in open(metrics_path)]
+    final = records[-1]
+    assert final["drained"] is True
+    for key in ("serve_queue_depth", "serve_ttft_p50_s",
+                "serve_tokens_per_sec", "serve_slot_occupancy"):
+        assert key in final
+    assert final["serve_completed"] == 2
+
+
+def test_cli_serve_requires_checkpoint_unless_allow_init(tmp_path, capsys):
+    from deeplearning_cfn_tpu.cli.main import main
+
+    args = ["serve", "--preset", "transformer_nmt_wmt", "--accelerator",
+            "cpu", "--requests", str(tmp_path / "nope.jsonl"),
+            *CLI_OVERRIDES, "model.kwargs.vocab_size=64",
+            f"workdir={tmp_path}"]
+    assert main(args) == 1  # no checkpoint, no --allow-init
+    capsys.readouterr()
+
+
+def test_cli_bench_serve_flag_exclusive(capsys):
+    from deeplearning_cfn_tpu.cli.main import main
+
+    assert main(["bench", "--serve", "--collectives"]) == 2
+
+
+def test_serve_bench_record_contract():
+    """The serving scenario emits the BENCH_* schema shape with real
+    latency percentiles."""
+    from deeplearning_cfn_tpu.serve.bench import run_serve_bench
+
+    rec = run_serve_bench(num_requests=4, slots=2, max_new_tokens=4,
+                          src_len=8)
+    assert {"metric", "value", "unit", "vs_baseline", "mfu",
+            "measured"} <= set(rec)
+    assert rec["measured"] is True
+    assert rec["unit"] == "tokens/sec"
+    assert rec["value"] is not None and rec["value"] > 0
+    assert rec["p50_latency_s"] is not None
+    assert rec["ttft_p95_s"] is not None
+    assert rec["engine_steps"] > 0

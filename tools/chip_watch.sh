@@ -17,6 +17,14 @@ ART=bench_artifacts
 PROBE_LOG="$ART/probe_$(STAMP).log"
 mkdir -p "$ART"
 
+# Record this watcher's PID at arm time so rearm_watch.sh can wait on the
+# exact process instead of pattern-matching command lines (pgrep -f matches
+# any process whose argv mentions the script — including the re-armer).
+# Kept out of $ART so commit_artifacts never sweeps transient state into
+# the committed evidence.
+PIDFILE="${CHIP_WATCH_PIDFILE:-/tmp/chip_watch.pid}"
+echo "$$" > "$PIDFILE"
+
 # The probe must assert a real accelerator: in the r01 failure mode the TPU
 # plugin RAISES and jax silently falls back to CPU, where a bare matmul
 # succeeds — that must not trigger (and thereby spend) the one-shot session.
@@ -50,15 +58,25 @@ commit_artifacts() {
 echo "$(STAMP) watcher armed (max $MAX_POLLS polls @ ${INTERVAL}s)" >> "$PROBE_LOG"
 for i in $(seq 1 "$MAX_POLLS"); do
   if timeout 120 python -c "$PROBE" >> "$PROBE_LOG" 2>&1; then
-    # Capture-time one-shot guard: if any watcher instance already ran the
-    # session (two can be armed across a session boundary), do not run a
-    # second one — it would race the first for the chip and for git.
-    if ls "$ART"/chip_session_*.log > /dev/null 2>&1; then
-      echo "$(STAMP) TPU OK (poll $i) but a session capture already exists — standing down" >> "$PROBE_LOG"
+    # Capture-time one-shot guard: two watchers can be armed across a
+    # session boundary and both probes can succeed in the same window, so
+    # a bare existence check races (check-then-create is not atomic). The
+    # guard IS the lock: noclobber (set -C) creation of a fixed-name lock
+    # file succeeds for exactly one watcher; the loser stands down. A
+    # capture from an earlier window leaves the lock behind, preserving
+    # the old "already ran — stand down" behaviour.
+    if ! ( set -C; echo "pid=$$ $(STAMP)" > "$ART/chip_session.lock" ) 2>/dev/null; then
+      echo "$(STAMP) TPU OK (poll $i) but the session lock is already held ($(cat "$ART/chip_session.lock" 2>/dev/null)) — standing down" >> "$PROBE_LOG"
       exit 0
     fi
     echo "$(STAMP) TPU OK (poll $i) — launching chip session" >> "$PROBE_LOG"
     SESSION_LOG="$ART/chip_session_$(STAMP).log"
+    # Same-stamp double-create is impossible past the lock, but create the
+    # session log noclobber too so a clobber can never destroy evidence.
+    ( set -C; : > "$SESSION_LOG" ) 2>/dev/null || {
+      echo "$(STAMP) session log $SESSION_LOG already exists — standing down" >> "$PROBE_LOG"
+      exit 0
+    }
     bash tools/chip_session.sh "$SESSION_LOG"
     echo "$(STAMP) chip session finished" >> "$PROBE_LOG"
     commit_artifacts "bench_artifacts: real-chip measurement session $(STAMP)"

@@ -12,10 +12,18 @@ NEW_POLLS="${1:-320}"
 INTERVAL="${2:-90}"
 cd "$(dirname "$0")/.."
 
-# Wait for any running watcher to finish its budget (or its capture).
-while pgrep -f 'chip_watch.sh' > /dev/null 2>&1; do
-  sleep 60
-done
+# Wait for the armed watcher to finish its budget (or its capture).
+# chip_watch.sh records its PID at arm time; waiting on that exact PID
+# replaces the old `pgrep -f 'chip_watch.sh'` loop, which pattern-matched
+# ANY process whose command line mentioned the script (this re-armer, an
+# editor, a grep) and could therefore spin forever or return early.
+PIDFILE="${CHIP_WATCH_PIDFILE:-/tmp/chip_watch.pid}"
+if [ -f "$PIDFILE" ]; then
+  WATCH_PID="$(cat "$PIDFILE" 2>/dev/null)"
+  while [ -n "$WATCH_PID" ] && kill -0 "$WATCH_PID" 2>/dev/null; do
+    sleep 60
+  done
+fi
 
 # If a session was already captured, the evidence exists — do not re-arm
 # (chip_session.sh is a one-shot full measurement; a second run would just
