@@ -335,6 +335,10 @@ def _train_on_stack(args, cfg: ExperimentConfig) -> int:
 
 
 def _cmd_bench(args) -> int:
+    if getattr(args, "smoke", False) and not getattr(args, "serve", False):
+        print("[dlcfn-tpu] --smoke is a serving-scenario mode — pass it "
+              "with --serve", file=sys.stderr)
+        return 2
     if getattr(args, "serve", False):
         if getattr(args, "ops", None) or args.collectives or \
                 getattr(args, "sweep_batches", None):
@@ -345,7 +349,9 @@ def _cmd_bench(args) -> int:
         from ..serve.bench import run_serve_bench
 
         line = run_serve_bench(num_requests=args.requests_count,
-                               slots=args.slots, beam_size=args.beam_size)
+                               slots=args.slots, beam_size=args.beam_size,
+                               decode_window=args.decode_window,
+                               smoke=args.smoke)
         print(json.dumps(line))
         return 0
     if getattr(args, "sweep_batches", None):
@@ -433,6 +439,7 @@ def _cmd_serve(args) -> int:
         engine, bpe, at_step = load_engine(
             cfg, capacity=args.slots, queue_depth=args.queue_depth,
             default_max_new_tokens=args.max_new_tokens,
+            decode_window=args.decode_window,
             step=args.step, vocab=args.vocab, allow_init=args.allow_init)
     except (FileNotFoundError, ValueError) as e:
         print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
@@ -442,7 +449,8 @@ def _cmd_serve(args) -> int:
               "no committed checkpoint) — smoke mode only", file=sys.stderr)
     else:
         print(f"[dlcfn-tpu] serving checkpoint step {at_step} "
-              f"({args.slots} slots)", file=sys.stderr)
+              f"({args.slots} slots, decode window {args.decode_window})",
+              file=sys.stderr)
 
     if args.requests == "-":
         lines = [ln for ln in sys.stdin if ln.strip()]
@@ -930,6 +938,11 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--beam-size", type=int, default=1,
                     help="default beam width for requests that don't set "
                          "their own (1 = greedy)")
+    sv.add_argument("--decode-window", type=int, default=4,
+                    help="max fused greedy decode steps per device call "
+                         "when no scheduling work is pending (1 = surface "
+                         "every token; larger amortizes dispatch at the "
+                         "cost of admission/eviction freshness)")
     sv.add_argument("--vocab", default="",
                     help="BPE vocab.json — required for \"text\" requests")
     sv.add_argument("--step", type=int, default=0,
@@ -995,6 +1008,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="serving scenario: slot-table capacity")
     be.add_argument("--beam-size", type=int, default=1,
                     help="serving scenario: beam width (1 = greedy)")
+    be.add_argument("--decode-window", type=int, default=4,
+                    help="serving scenario: fused decode steps per device "
+                         "call (1 = the host-driven per-token loop)")
+    be.add_argument("--smoke", action="store_true",
+                    help="serving scenario: CI fast mode (few requests, "
+                         "tiny budget, same record contract)")
     be.set_defaults(fn=_cmd_bench)
 
     met = sub.add_parser(

@@ -170,6 +170,21 @@ class TransformerNMT(nn.Module):
         y = self.dec_norm(y)
         return self.embed.logits(y)
 
+    def greedy_step_at(self, tgt_id, enc, src_mask, pos):
+        """Fused greedy variant of :meth:`decode_step_at`: the argmax runs
+        in-model, so the step returns next-token ids [B] int32 and the
+        [B, V] logits never leave the device. This is the serving hot-loop
+        form (serve/engine.py): a greedy tick needs only the chosen token,
+        and shipping the full logits matrix to the host per token is the
+        PCIe/host-sync cost continuous batching exists to avoid. The f32
+        cast before argmax matches what the host path did to the logits, so
+        token choice is identical to argmax over :meth:`decode_step_at`'s
+        output (ties break to the lowest index in both).
+        """
+        logits = self.decode_step_at(tgt_id, enc, src_mask, pos)
+        return jnp.argmax(logits[:, 0, :].astype(jnp.float32),
+                          axis=-1).astype(jnp.int32)
+
     def __call__(self, src_ids, src_mask, tgt_in_ids, train: bool = True):
         enc = self.encode(src_ids, src_mask, train=train)
         return self.decode(tgt_in_ids, enc, src_mask, train=train)

@@ -4,7 +4,9 @@ Turns a trained checkpoint into a request-serving engine built on the
 KV-cache decoder machinery (models/decoding.py, models/transformer_nmt.py):
 
 - :mod:`.engine` — continuous-batching scheduler over a fixed slot table of
-  per-row KV-cache positions;
+  per-row KV-cache positions; greedy traffic runs a device-resident fast
+  path (fused argmax step, `lax.scan` decode windows, donated KV cache,
+  batched admission prefill);
 - :mod:`.queue` — bounded request lifecycle (submit/poll/cancel, deadlines,
   explicit overload rejection);
 - :mod:`.loader` — checkpoint restore + tokenizer binding;

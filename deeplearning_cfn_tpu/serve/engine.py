@@ -1,15 +1,29 @@
 """Continuous-batching inference engine over the KV-cache decoder.
 
 The serving core: a fixed-capacity **slot table** of KV-cache rows driven by
-one jitted single-position decode per step (models/transformer_nmt.py
-``decode_step_at``). Unlike the offline searchers in models/decoding.py —
-which scan a whole batch in lockstep from position 0 to max_len — every row
-here carries its own decode position, so the engine admits queued requests
-into free rows *mid-flight*, evicts rows the moment their request hits EOS /
-budget / deadline, and recycles them for the next request without stalling
-the neighbours. That is continuous batching: the device always sees one
-fixed-shape [capacity, 1] decode step, and the scheduler swaps work in and
-out of rows between steps.
+jitted decode steps against per-row positions (models/transformer_nmt.py
+``decode_step_at`` / ``greedy_step_at``). Unlike the offline searchers in
+models/decoding.py — which scan a whole batch in lockstep from position 0 to
+max_len — every row here carries its own decode position, so the engine
+admits queued requests into free rows *mid-flight*, evicts rows the moment
+their request hits EOS / budget / deadline, and recycles them for the next
+request without stalling the neighbours. That is continuous batching: the
+device always sees one fixed-shape [capacity, 1] decode step, and the
+scheduler swaps work in and out of rows between steps.
+
+The decode hot loop is device-resident. Greedy traffic runs through a
+**fused step** (argmax, EOS/budget detection, ``prev``/``pos`` advance all
+inside the jit), so a tick surfaces only a [capacity] token vector and a
+[capacity] done mask — never the [capacity, V] logits matrix. When the
+scheduler has nothing to do between steps (queue drained or all rows busy,
+no deadlines pending), it runs ``decode_window`` fused steps in ONE device
+call via ``lax.scan`` (a *decode window*), amortizing dispatch overhead;
+rows that finish mid-window are active-masked and emit PAD at zero cost.
+The KV cache (and the encoder/source-mask tables on admission) are donated
+into each device call — updates land in place, no per-step full-cache copy.
+Beam rows still use a logits-returning step: their top-k candidate
+selection is replicated from models/decoding.py on purpose, so beam parity
+stays untouched.
 
 Row recycling needs no cache zeroing: the per-row step bias only exposes
 positions ``<= pos[row]``, so restarting a row at position 0 hides whatever
@@ -27,21 +41,23 @@ Search modes per request:
   permutation. Final hypothesis pick uses the same GNMT length norm.
 
 Both modes are parity-tested token-identical against models/decoding.py
-(tests/test_serve.py).
+(tests/test_serve.py), for every decode-window size.
 
 Scheduler invariants (tested):
 - a row is owned by at most one request at a time;
 - admits happen only into free rows, in FIFO submit order (a beam group
   that doesn't fit blocks later requests — no out-of-order sneak-in);
 - overload surfaces as queue.OverloadError at submit, never silent growth;
-- a cancelled or expired request frees its rows within one step.
+- a cancelled or expired request frees its rows within one decode window
+  (one step when any running request carries a deadline — the scheduler
+  drops to window size 1 so expiry is never deferred).
 """
 
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -58,7 +74,7 @@ class _Group:
 
     req: Request
     rows: List[int]
-    budget: int  # decode-step budget (<= model.max_len)
+    budget: int  # decode-step budget (< model.max_len)
     steps: int = 0
     # Beam-search state (beam_size > 1): replicates beam_decode_cached's
     # carry. beam_tokens column 0 is BOS, column t+1 the step-t choice.
@@ -73,29 +89,43 @@ class Engine:
 
     ``capacity`` is the number of KV-cache rows (the slot table size);
     ``max_src_len`` the fixed source padding length every request is encoded
-    at. The engine is host-driven: :meth:`step` runs one decode over all
-    rows and does admission/eviction around it; :meth:`run_until_drained`
-    loops it — the offline driver mode `dlcfn-tpu serve --requests` uses.
+    at. ``decode_window`` is the maximum number of fused greedy steps one
+    device call may run when no scheduling work is pending (1 = surface to
+    the host after every token, today's most-responsive behavior; larger
+    windows amortize dispatch at the cost of admission/eviction freshness —
+    see docs/SERVING.md). The engine is host-driven at window granularity:
+    :meth:`step` runs one decode window over all rows and does
+    admission/eviction around it; :meth:`run_until_drained` loops it — the
+    offline driver mode `dlcfn-tpu serve --requests` uses.
     """
 
     def __init__(self, model, variables, capacity: int = 4,
                  max_src_len: int = 0, queue_depth: int = 64,
                  default_max_new_tokens: int = 64,
                  length_penalty: float = 0.6,
+                 decode_window: int = 1,
                  clock=time.monotonic,
                  metrics: Optional[ServeMetrics] = None):
         if capacity <= 0:
             raise ValueError(f"capacity must be positive, got {capacity}")
+        if decode_window <= 0:
+            raise ValueError(
+                f"decode_window must be positive, got {decode_window}")
         self.model = model
         self.variables = variables
         self.capacity = capacity
+        self.decode_window = int(decode_window)
         self.model_max_len = int(getattr(model, "max_len", 0) or 0)
         if self.model_max_len <= 0:
             raise ValueError("model must expose max_len (the KV-cache size)")
         self.max_src_len = int(max_src_len) if max_src_len else \
             self.model_max_len
+        # Budgets are clamped to max_len - 1, not max_len: step s writes
+        # its prev token's K/V at position s, so position max_len - 1 is
+        # the last writable slot and a budget of max_len would have the
+        # final step silently re-writing it (the clamp bug this replaces).
         self.default_max_new_tokens = min(default_max_new_tokens,
-                                          self.model_max_len)
+                                          self.model_max_len - 1)
         self.length_penalty = length_penalty
         self._clock = clock
         self.queue = RequestQueue(max_depth=queue_depth, clock=clock)
@@ -113,8 +143,12 @@ class Engine:
                 method=mcls.decode_step_at, mutable=["cache"])
             return logits[:, 0, :].astype(jnp.float32), mut["cache"]
 
-        self._step_fn = jax.jit(_step)
-        self._beam_select_fns: Dict[int, object] = {}
+        # The cache is donated into every decode call: each tick updates
+        # it in place (train/trainer.py's donation pattern) instead of
+        # allocating a full copy next to the old one.
+        self._step_fn = jax.jit(_step, donate_argnums=(1,))
+        self._window_fns: Dict[int, Callable] = {}
+        self._beam_select_fns: Dict[int, Callable] = {}
 
         cap = self.capacity
 
@@ -123,13 +157,24 @@ class Engine:
                 lambda c: c[perm] if getattr(c, "ndim", 0) > 0
                 and c.shape[0] == cap else c, cache)
 
-        self._permute_fn = jax.jit(_permute)
+        self._permute_fn = jax.jit(_permute, donate_argnums=(0,))
 
-        # Device state. One warmup encode fixes enc's shape/dtype (and
-        # pre-compiles the encoder for the serving shape).
+        def _scatter(enc_table, mask_table, enc_new, mask_new, rows):
+            # Admission scatter: one donated update for the whole admit
+            # batch. Out-of-bounds rows (the unused tail of a partial
+            # batch) are dropped by jax scatter semantics, so no masking
+            # branch is needed.
+            return enc_table.at[rows].set(enc_new), \
+                mask_table.at[rows].set(mask_new)
+
+        self._admit_scatter_fn = jax.jit(_scatter, donate_argnums=(0, 1))
+
+        # Device state. One warmup encode at the full admission batch shape
+        # fixes enc's shape/dtype and pre-compiles the encoder for the one
+        # shape admission ever uses ([capacity, max_src_len]).
         s = self.max_src_len
-        dummy_src = jnp.zeros((1, s), jnp.int32)
-        dummy_mask = jnp.zeros((1, s), jnp.int32)
+        dummy_src = jnp.zeros((cap, s), jnp.int32)
+        dummy_mask = jnp.zeros((cap, s), jnp.int32)
         enc1 = self._encode_fn(variables, dummy_src, dummy_mask)
         self._enc = jnp.zeros((cap, s, enc1.shape[-1]), enc1.dtype)
         self._src_mask = jnp.zeros((cap, s), jnp.int32)
@@ -137,7 +182,8 @@ class Engine:
             jax.random.PRNGKey(0), jnp.zeros((cap, 1), jnp.int32),
             self._enc, self._src_mask, jnp.zeros((cap,), jnp.int32),
             method=mcls.decode_step_at)["cache"]
-        # Host-side per-row state.
+        # Host-side per-row state (scheduler-authoritative; uploaded into
+        # each device call and refreshed from its outputs).
         self._prev = np.full((cap,), PAD_ID, np.int32)
         self._pos = np.zeros((cap,), np.int32)
         self._row_owner: List[Optional[str]] = [None] * cap
@@ -160,7 +206,7 @@ class Engine:
                 f"beam_size {beam_size} exceeds the slot capacity "
                 f"{self.capacity} — it could never be admitted")
         budget = min(max_new_tokens or self.default_max_new_tokens,
-                     self.model_max_len)
+                     self.model_max_len - 1)
         try:
             req = self.queue.submit(src_ids, budget, beam_size=beam_size,
                                     deadline_s=deadline_s,
@@ -229,7 +275,12 @@ class Engine:
                 self._release(g, RequestState.EXPIRED, now)
 
     def _admit(self, now: float) -> None:
+        """Admit every queued request that fits, then prefill them all in
+        ONE padded encode + one donated scatter into the row tables —
+        instead of N sequential [1, S] encodes and N full-table
+        ``.at[r].set`` copies."""
         free = self._free_rows()
+        admits: List[_Group] = []
         while free:
             req = self.queue.pop_ready(now)
             if req is None:
@@ -240,17 +291,9 @@ class Engine:
                 self.queue.requeue_front(req)
                 break
             rows, free = free[:w], free[w:]
-            src = np.full((1, self.max_src_len), PAD_ID, np.int32)
-            src[0, :len(req.src_ids)] = req.src_ids
-            mask = (src != PAD_ID).astype(np.int32)
-            enc1 = self._encode_fn(self.variables, jnp.asarray(src),
-                                   jnp.asarray(mask))
-            mask_row = jnp.asarray(mask[0])
             for r in rows:
                 assert self._row_owner[r] is None, \
                     f"admit into occupied row {r}"
-                self._enc = self._enc.at[r].set(enc1[0])
-                self._src_mask = self._src_mask.at[r].set(mask_row)
                 self._prev[r] = BOS_ID
                 self._pos[r] = 0
                 self._row_owner[r] = req.id
@@ -262,10 +305,36 @@ class Engine:
                 group.beam_tokens = np.full((w, group.budget + 1), PAD_ID,
                                             np.int32)
                 group.beam_tokens[:, 0] = BOS_ID
+            admits.append(group)
             self._groups.append(group)
             req.state = RequestState.RUNNING
             req.admitted_at = now
-            self.metrics.record_admit()
+            self.metrics.record_admit(now - req.submitted_at)
+        if not admits:
+            return
+        # Batched prefill: the encode batch is always [capacity, S] (one
+        # compile, ever) — slot j encodes the source for target row
+        # row_targets[j]; unused slots stay PAD with row target `capacity`,
+        # an out-of-bounds index the scatter drops. A beam group's source
+        # occupies one slot per row: the encoder is row-independent, so
+        # the copies are bit-identical to encoding it once.
+        cap, s = self.capacity, self.max_src_len
+        src = np.full((cap, s), PAD_ID, np.int32)
+        row_targets = np.full((cap,), cap, np.int32)
+        j = 0
+        for group in admits:
+            row_src = np.full((s,), PAD_ID, np.int32)
+            row_src[:len(group.req.src_ids)] = group.req.src_ids
+            for r in group.rows:
+                src[j] = row_src
+                row_targets[j] = r
+                j += 1
+        mask = (src != PAD_ID).astype(np.int32)
+        enc_new = self._encode_fn(self.variables, jnp.asarray(src),
+                                  jnp.asarray(mask))
+        self._enc, self._src_mask = self._admit_scatter_fn(
+            self._enc, self._src_mask, enc_new, jnp.asarray(mask),
+            jnp.asarray(row_targets))
 
     def _beam_select(self, w: int):
         """Jitted per-group candidate selection — the same f32 log-softmax
@@ -287,17 +356,134 @@ class Engine:
             self._beam_select_fns[w] = fn
         return fn
 
+    # -- the fused window --------------------------------------------------
+
+    def _window_fn(self, k: int):
+        """Jitted K-step fused greedy window: ``lax.scan`` over K
+        ``greedy_step_at`` applications with argmax, EOS/budget/cache-
+        exhaustion detection, and prev/pos advance all on device. Returns
+        per-step token + was-active matrices [K, capacity] (rows emit PAD
+        after finishing — active-masked, zero extra cost) plus the final
+        carry, so the host sees K tokens' worth of progress in one
+        transfer and never the [capacity, V] logits."""
+        fn = self._window_fns.get(k)
+        if fn is not None:
+            return fn
+        model, mcls = self.model, type(self.model)
+        max_len = self.model_max_len
+
+        def window(v, cache, prev, pos, steps_left, active, enc, src_mask):
+            def body(carry, _):
+                cache, prev, pos, steps_left, active = carry
+                nxt, mut = model.apply(
+                    {**v, "cache": cache}, prev[:, None], enc, src_mask,
+                    pos, method=mcls.greedy_step_at, mutable=["cache"])
+                cache = mut["cache"]
+                token = jnp.where(active, nxt, PAD_ID)
+                steps_left = steps_left - active.astype(jnp.int32)
+                new_pos = pos + active.astype(jnp.int32)
+                # Cache exhaustion: position max_len - 1 was the last
+                # writable slot, so a row whose next step would need
+                # position max_len terminates instead of re-writing it.
+                done_now = active & ((token == EOS_ID) | (steps_left <= 0)
+                                     | (new_pos >= max_len))
+                active = active & ~done_now
+                prev = jnp.where(active, token, PAD_ID)
+                pos = jnp.minimum(new_pos, max_len - 1)
+                return (cache, prev, pos, steps_left, active), \
+                    (token, done_now)
+            carry = (cache, prev, pos, steps_left, active)
+            (cache, prev, pos, steps_left, active), (tokens, done_at) = \
+                jax.lax.scan(body, carry, None, length=k)
+            return tokens, done_at, prev, pos, active, cache
+
+        fn = jax.jit(window, donate_argnums=(1,))
+        self._window_fns[k] = fn
+        return fn
+
+    def _plan_window(self) -> int:
+        """How many fused steps the next device call may run. Windows > 1
+        are only safe when the scheduler provably has nothing to do at
+        intermediate steps: greedy-only traffic (beam rows need per-step
+        host top-k), no running deadlines (expiry must land within one
+        step of its time), and no admissible queued work (queue empty, or
+        every row busy so nothing could admit until an eviction — which
+        itself lands at the window boundary)."""
+        if self.decode_window <= 1:
+            return 1
+        if any(g.req.beam_size > 1 for g in self._groups):
+            return 1
+        if any(g.req.deadline is not None for g in self._groups):
+            return 1
+        if self.queue.depth > 0 and any(
+                o is None for o in self._row_owner):
+            return 1
+        return self.decode_window
+
     # -- the step ----------------------------------------------------------
 
-    def step(self) -> bool:
-        """One engine tick: reap → admit → decode all rows → per-group
-        search bookkeeping → evict finished. Returns True iff a decode
-        step ran (False = fully idle)."""
+    def step(self) -> int:
+        """One engine tick: reap → admit (batched prefill) → one decode
+        window over all rows → per-group bookkeeping → evict finished.
+        Returns the number of decode steps run (0 = fully idle). Greedy-
+        only ticks run the fused device-resident path (possibly a multi-
+        step window); any tick with a beam group falls back to the
+        single-step logits path so beam parity is untouched."""
         now = self._clock()
         self._reap(now)
         self._admit(now)
         if not self._groups:
-            return False
+            return 0
+        if any(g.req.beam_size > 1 for g in self._groups):
+            return self._host_step()
+        return self._fused_step(self._plan_window())
+
+    def _fused_step(self, k: int) -> int:
+        """Greedy fast path: K fused steps in one device call."""
+        cap = self.capacity
+        steps_left = np.zeros((cap,), np.int32)
+        active = np.zeros((cap,), bool)
+        for g in self._groups:
+            r = g.rows[0]
+            steps_left[r] = g.budget - g.steps
+            active[r] = True
+        t0 = self._clock()
+        tokens, done_at, prev, pos, _, self.cache = self._window_fn(k)(
+            self.variables, self.cache, jnp.asarray(self._prev),
+            jnp.asarray(self._pos), jnp.asarray(steps_left),
+            jnp.asarray(active), self._enc, self._src_mask)
+        # The only device→host traffic of the whole window: [K, capacity]
+        # int32 tokens + bool done marks and the [capacity] carry vectors.
+        tokens = np.asarray(tokens)
+        done_at = np.asarray(done_at)
+        # np.array (not asarray): the device views are read-only and the
+        # scheduler mutates these mirrors on release/admit.
+        self._prev = np.array(prev, np.int32)
+        self._pos = np.array(pos, np.int32)
+        dt = self._clock() - t0
+        now = self._clock()
+        new_tokens = 0
+        for g in list(self._groups):
+            r = g.rows[0]
+            for step_k in range(k):
+                g.req.tokens.append(int(tokens[step_k, r]))
+                g.steps += 1
+                new_tokens += 1
+                if g.req.first_token_at is None:
+                    g.req.first_token_at = now
+                    self.metrics.record_first_token(g.req.ttft_s)
+                if done_at[step_k, r]:
+                    self._release(g, RequestState.DONE, now)
+                    break
+        self.metrics.record_step(new_tokens, self.queue.depth, new_tokens,
+                                 dt, steps=k)
+        return k
+
+    def _host_step(self) -> int:
+        """Logits-returning path for ticks with beam rows: beam candidate
+        selection replicates models/decoding.py on host-visible logits (the
+        parity contract); greedy rows sharing the tick ride along exactly
+        as they always did."""
         t0 = self._clock()
         logits, self.cache = self._step_fn(
             self.variables, self.cache, jnp.asarray(self._prev[:, None]),
@@ -315,12 +501,13 @@ class Engine:
                 nxt = int(np.argmax(logits[r]))
                 g.req.tokens.append(nxt)
                 self._prev[r] = nxt
+                exhausted = self._pos[r] + 1 >= self.model_max_len
                 self._pos[r] = min(self._pos[r] + 1, self.model_max_len - 1)
                 g.steps += 1
                 if g.req.first_token_at is None:
                     g.req.first_token_at = now
                     self.metrics.record_first_token(g.req.ttft_s)
-                if nxt == EOS_ID or g.steps >= g.budget:
+                if nxt == EOS_ID or g.steps >= g.budget or exhausted:
                     self._release(g, RequestState.DONE, now)
             else:
                 w = g.req.beam_size
@@ -339,15 +526,18 @@ class Engine:
                     for j in range(w):
                         perm[g.rows[j]] = g.rows[beam_idx[j]]
                     perm_needed = True
+                exhausted = False
                 for j, r in enumerate(g.rows):
                     self._prev[r] = int(tok_idx[j])
+                    exhausted |= self._pos[r] + 1 >= self.model_max_len
                     self._pos[r] = min(self._pos[r] + 1,
                                        self.model_max_len - 1)
                 g.steps += 1
                 if g.req.first_token_at is None:
                     g.req.first_token_at = now
                     self.metrics.record_first_token(g.req.ttft_s)
-                if bool(g.beam_done.all()) or g.steps >= g.budget:
+                if bool(g.beam_done.all()) or g.steps >= g.budget \
+                        or exhausted:
                     # All-done early exit is parity-safe: finished beams
                     # only extend with PAD at zero cost, so later steps
                     # cannot change the normalized-argmax winner.
@@ -357,13 +547,14 @@ class Engine:
             self.cache = self._permute_fn(self.cache, jnp.asarray(perm))
         self.metrics.record_step(rows_active, self.queue.depth, new_tokens,
                                  self._clock() - t0)
-        return True
+        return 1
 
     def run_until_drained(self, max_steps: int = 1_000_000,
                           writer=None, emit_every: int = 0) -> int:
         """Step until queue and slots are empty (the offline driver loop).
-        Optionally emits a metrics record every ``emit_every`` steps and a
-        final one on drain. Returns the number of steps taken."""
+        Optionally emits a metrics record every ``emit_every`` ticks and a
+        final one on drain. Returns the number of engine ticks taken (a
+        tick may run up to ``decode_window`` decode steps)."""
         steps = 0
         while (self.queue.depth > 0 or self._groups) and steps < max_steps:
             self.step()

@@ -24,6 +24,7 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
                 max_src_len: int = 0, queue_depth: int = 64,
                 default_max_new_tokens: int = 64,
                 length_penalty: Optional[float] = None,
+                decode_window: int = 1,
                 step: int = 0, vocab: str = "", allow_init: bool = False,
                 clock=time.monotonic) -> Tuple[Engine, object, int]:
     """Build an Engine from a trained experiment.
@@ -69,5 +70,6 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
         default_max_new_tokens=default_max_new_tokens,
         length_penalty=cfg.eval.length_penalty
         if length_penalty is None else length_penalty,
+        decode_window=decode_window,
         clock=clock)
     return engine, bpe, int(at_step)

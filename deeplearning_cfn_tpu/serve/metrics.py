@@ -2,12 +2,20 @@
 
 One flat record per emit, every key prefixed ``serve_`` so serving metrics
 coexist with training records in the same JSONL stream (and `dlcfn-tpu
-metrics` keeps ignoring them). The four headline signals the ISSUE names:
+metrics` keeps ignoring them). The headline signals:
 
-- queue depth (admission backlog),
+- queue depth (admission backlog) and queue wait (submit → admit — the
+  admission latency that TTFT alone hides),
 - time-to-first-token (submit → first generated token),
 - tokens/sec (generated tokens over engine-busy wall time),
-- slot occupancy (active rows / capacity, averaged over steps).
+- slot occupancy (active rows / capacity, averaged over decode steps),
+- per-step decode latency (device call time / steps in the call — the
+  number decode windows exist to shrink).
+
+Step accounting is window-aware: one :meth:`record_step` call covers one
+device call, which since the device-resident fast path may span several
+fused decode steps (``steps``). ``serve_steps`` counts decode steps,
+``serve_decode_windows`` counts device calls.
 """
 
 from __future__ import annotations
@@ -47,8 +55,10 @@ class ServeMetrics:
         self.completed = 0
         self.cancelled = 0
         self.expired = 0
-        # Step accounting.
+        # Step accounting. `steps` counts decode steps; `windows` counts
+        # device calls (a fused window is one call spanning many steps).
         self.steps = 0
+        self.windows = 0
         self.tokens_generated = 0
         self.busy_time_s = 0.0
         self._occupancy_sum = 0.0
@@ -56,6 +66,8 @@ class ServeMetrics:
         # Distributions.
         self.ttft_s: List[float] = []
         self.latency_s: List[float] = []
+        self.queue_wait_s: List[float] = []
+        self.step_latency_s: List[float] = []
 
     # -- recording hooks (called by the engine) ----------------------------
 
@@ -65,8 +77,10 @@ class ServeMetrics:
     def record_reject(self) -> None:
         self.rejected += 1
 
-    def record_admit(self) -> None:
+    def record_admit(self, queue_wait_s: Optional[float] = None) -> None:
         self.admitted += 1
+        if queue_wait_s is not None:
+            self.queue_wait_s.append(queue_wait_s)
 
     def record_first_token(self, ttft: float) -> None:
         self.ttft_s.append(ttft)
@@ -81,12 +95,22 @@ class ServeMetrics:
         if latency is not None:
             self.latency_s.append(latency)
 
-    def record_step(self, active_rows: int, queue_depth: int,
-                    new_tokens: int, step_time_s: float) -> None:
-        self.steps += 1
+    def record_step(self, active_rows: float, queue_depth: int,
+                    new_tokens: int, step_time_s: float,
+                    steps: int = 1) -> None:
+        """One device call covering ``steps`` decode steps.
+
+        ``active_rows`` is the total active row-steps across the call
+        (for a single step, simply the active row count), so occupancy
+        stays an average over decode steps whatever the window size.
+        """
+        steps = max(int(steps), 1)
+        self.steps += steps
+        self.windows += 1
         self.tokens_generated += new_tokens
         self.busy_time_s += step_time_s
         self._occupancy_sum += active_rows / max(self.capacity, 1)
+        self.step_latency_s.append(step_time_s / steps)
         self.last_queue_depth = queue_depth
 
     # -- reporting ---------------------------------------------------------
@@ -103,6 +127,12 @@ class ServeMetrics:
             return None
         return self._occupancy_sum / self.steps
 
+    @property
+    def mean_steps_per_window(self) -> Optional[float]:
+        if self.windows == 0:
+            return None
+        return self.steps / self.windows
+
     def snapshot(self) -> Dict:
         return {
             "serve_submitted": self.submitted,
@@ -112,15 +142,21 @@ class ServeMetrics:
             "serve_cancelled": self.cancelled,
             "serve_expired": self.expired,
             "serve_steps": self.steps,
+            "serve_decode_windows": self.windows,
+            "serve_steps_per_window": self.mean_steps_per_window,
             "serve_queue_depth": self.last_queue_depth,
             "serve_slot_capacity": self.capacity,
             "serve_slot_occupancy": self.mean_slot_occupancy,
             "serve_tokens_generated": self.tokens_generated,
             "serve_tokens_per_sec": self.tokens_per_sec,
+            "serve_queue_wait_p50_s": percentile(self.queue_wait_s, 50),
+            "serve_queue_wait_p95_s": percentile(self.queue_wait_s, 95),
             "serve_ttft_p50_s": percentile(self.ttft_s, 50),
             "serve_ttft_p95_s": percentile(self.ttft_s, 95),
             "serve_latency_p50_s": percentile(self.latency_s, 50),
             "serve_latency_p95_s": percentile(self.latency_s, 95),
+            "serve_step_latency_p50_s": percentile(self.step_latency_s, 50),
+            "serve_step_latency_p95_s": percentile(self.step_latency_s, 95),
             "serve_uptime_s": self._clock() - self.started_at,
         }
 

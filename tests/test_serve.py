@@ -140,6 +140,31 @@ def test_serve_metrics_empty_distributions_are_null():
     assert snap["serve_latency_p95_s"] is None
     assert snap["serve_tokens_per_sec"] is None
     assert snap["serve_slot_occupancy"] is None
+    assert snap["serve_queue_wait_p50_s"] is None
+    assert snap["serve_step_latency_p95_s"] is None
+    assert snap["serve_steps_per_window"] is None
+
+
+def test_serve_metrics_queue_wait_and_window_accounting():
+    """Admission latency (submit→admit) is a first-class distribution, and
+    step accounting splits decode steps from device calls (windows)."""
+    m = ServeMetrics(capacity=4, clock=FakeClock())
+    m.record_admit(0.2)
+    m.record_admit(0.4)
+    m.record_admit()  # wait unknown — counted, not distributed
+    assert m.admitted == 3
+    snap = m.snapshot()
+    assert snap["serve_queue_wait_p50_s"] == pytest.approx(0.3)
+    assert snap["serve_queue_wait_p95_s"] == pytest.approx(0.39)
+    # One 4-step window on 2 rows: 8 active row-steps, 8 tokens, 0.2 s.
+    m.record_step(8, 0, 8, 0.2, steps=4)
+    snap = m.snapshot()
+    assert snap["serve_steps"] == 4
+    assert snap["serve_decode_windows"] == 1
+    assert snap["serve_steps_per_window"] == 4.0
+    assert snap["serve_slot_occupancy"] == pytest.approx(0.5)
+    assert snap["serve_step_latency_p50_s"] == pytest.approx(0.05)
+    assert snap["serve_tokens_per_sec"] == pytest.approx(40.0)
 
 
 # -- engine: shared tiny model ----------------------------------------------
@@ -368,6 +393,148 @@ def test_mixed_greedy_and_beam_parity(parity_setup):
         assert decoding.strip_special(eng.poll(r.id).tokens) == want
 
 
+# -- engine: device-resident fast path (fused steps, windows, donation) -----
+
+
+@pytest.mark.parametrize("window", [1, 4, PARITY_NEW_TOKENS + 20])
+def test_windowed_greedy_parity(parity_setup, window):
+    """The fused/windowed greedy path is token-identical to
+    greedy_decode_cached for window sizes 1, 4, and > the decode budget —
+    windows are a dispatch optimization, never a search change."""
+    model, variables, srcs = parity_setup
+    direct = [_direct_decode(model, variables, s, 1) for s in srcs]
+    eng = Engine(model, variables, capacity=2, max_src_len=PARITY_SRC_LEN,
+                 default_max_new_tokens=PARITY_NEW_TOKENS,
+                 decode_window=window)
+    reqs = [eng.submit(s) for s in srcs]
+    eng.run_until_drained()
+    got = [decoding.strip_special(eng.poll(r.id).tokens) for r in reqs]
+    assert got == direct
+
+
+def test_windowed_engine_keeps_beam_parity(parity_setup):
+    """A windowed engine drops to the single-step logits path for beam
+    groups — beam output is unchanged by the decode_window knob."""
+    model, variables, srcs = parity_setup
+    direct = [_direct_decode(model, variables, s, 2) for s in srcs]
+    eng = Engine(model, variables, capacity=4, max_src_len=PARITY_SRC_LEN,
+                 default_max_new_tokens=PARITY_NEW_TOKENS, decode_window=8)
+    reqs = [eng.submit(s, beam_size=2) for s in srcs]
+    eng.run_until_drained()
+    got = [decoding.strip_special(eng.poll(r.id).tokens) for r in reqs]
+    assert got == direct
+
+
+def test_greedy_path_never_materializes_logits(sched_model):
+    """The acceptance contract: greedy traffic must not ship the
+    [capacity, V] logits matrix to the host per token. The logits-returning
+    step is reserved for beam rows, so on all-greedy traffic it is never
+    invoked — whatever the window size."""
+    for window in (1, 4):
+        eng = _mk_engine(sched_model, capacity=2, queue_depth=16,
+                         decode_window=window)
+
+        def _boom(*a, **k):
+            raise AssertionError(
+                "logits step ran on an all-greedy trace")
+
+        eng._step_fn = _boom
+        reqs = [eng.submit(_src(i), max_new_tokens=3) for i in range(5)]
+        eng.run_until_drained()
+        assert all(eng.poll(r.id).state is RequestState.DONE for r in reqs)
+
+
+def test_cache_is_donated_into_the_step(sched_model):
+    """The KV cache is donated into every decode call: after a step, the
+    previous cache buffers are consumed (updated in place), not left as a
+    live full-size copy."""
+    eng = _mk_engine(sched_model, capacity=2, decode_window=2)
+    eng.submit(_src(1), max_new_tokens=6)
+    eng.step()
+    stale = jax.tree_util.tree_leaves(eng.cache)
+    eng.step()
+    fresh = jax.tree_util.tree_leaves(eng.cache)
+    assert any(l.is_deleted() for l in stale if getattr(l, "ndim", 0) >= 4)
+    # The engine itself never holds a deleted buffer: stepping twice more
+    # works and the live cache is fully readable.
+    eng.run_until_drained()
+    assert all(not l.is_deleted() for l in
+               jax.tree_util.tree_leaves(eng.cache))
+    del fresh
+
+
+def test_budget_clamps_below_cache_size_and_terminates(sched_model):
+    """A request asking for more tokens than the KV cache holds is clamped
+    to max_len - 1 at submit and terminates at cache exhaustion — it never
+    silently re-writes the last cache slot forever."""
+    model, _ = sched_model
+    eng = _mk_engine(sched_model, capacity=1, decode_window=4)
+    req = eng.submit(_src(1), max_new_tokens=10**6)
+    assert req.max_new_tokens == model.max_len - 1
+    ticks = eng.run_until_drained(max_steps=5 * model.max_len)
+    assert eng.poll(req.id).state is RequestState.DONE
+    assert len(req.tokens) <= model.max_len - 1
+    assert ticks < 5 * model.max_len  # drained, not max_steps-capped
+
+
+def test_cancel_eviction_lands_within_one_window(sched_model):
+    clock = FakeClock()
+    eng = _mk_engine(sched_model, clock=clock, capacity=1, decode_window=4)
+    a = eng.submit(_src(1), max_new_tokens=30)
+    eng.step()
+    assert eng.poll(a.id).state is RequestState.RUNNING
+    assert eng.cancel(a.id) is True
+    eng.step()  # the very next window reaps it
+    assert eng.poll(a.id).state is RequestState.CANCELLED
+    assert eng.slot_view() == [None]
+    assert eng.poll(a.id).tokens, "partial output is kept"
+
+
+def test_deadline_eviction_lands_within_one_window(sched_model):
+    """A running deadline forces the scheduler to window size 1, so expiry
+    is detected within one step — a large decode_window must not defer it."""
+    clock = FakeClock()
+    eng = _mk_engine(sched_model, clock=clock, capacity=1, decode_window=8)
+    a = eng.submit(_src(1), max_new_tokens=30, deadline_s=5.0)
+    eng.step()
+    n_before = len(eng.poll(a.id).tokens)
+    assert eng._plan_window() == 1  # deadline pending → per-step ticks
+    clock.advance(10.0)
+    eng.step()
+    assert eng.poll(a.id).state is RequestState.EXPIRED
+    # The expiring tick reaped before decoding: no token generated past
+    # the deadline.
+    assert len(eng.poll(a.id).tokens) == n_before
+
+
+def test_windowed_slot_churn_keeps_invariants(sched_model):
+    """The slot-exclusivity and parity-of-neighbours guarantees survive
+    multi-step windows under constant turnover."""
+    eng_solo = _mk_engine(sched_model, capacity=2, decode_window=4)
+    long_solo = eng_solo.submit(_src(7), max_new_tokens=12)
+    eng_solo.run_until_drained()
+
+    eng = _mk_engine(sched_model, capacity=3, queue_depth=32,
+                     decode_window=4)
+    long_req = eng.submit(_src(7), max_new_tokens=12)
+    shorts = [eng.submit(_src(20 + i), max_new_tokens=2 + i % 3)
+              for i in range(8)]
+    steps = 0
+    while eng.queue.depth > 0 or eng.active_requests:
+        eng.step()
+        steps += 1
+        owners = eng.slot_view()
+        running = {g.req.id: g.rows for g in eng._groups}
+        claimed = [r for rows in running.values() for r in rows]
+        assert len(claimed) == len(set(claimed)), "row in two groups"
+        for rid, rows in running.items():
+            assert all(owners[r] == rid for r in rows)
+        assert steps < 200
+    assert eng.poll(long_req.id).tokens == \
+        eng_solo.poll(long_solo.id).tokens
+    assert all(eng.poll(s.id).state is RequestState.DONE for s in shorts)
+
+
 # -- CLI + bench ------------------------------------------------------------
 
 CLI_OVERRIDES = [
@@ -450,11 +617,13 @@ def test_cli_bench_serve_flag_exclusive(capsys):
     from deeplearning_cfn_tpu.cli.main import main
 
     assert main(["bench", "--serve", "--collectives"]) == 2
+    assert main(["bench", "--smoke"]) == 2  # smoke is a --serve mode
 
 
 def test_serve_bench_record_contract():
     """The serving scenario emits the BENCH_* schema shape with real
-    latency percentiles."""
+    latency percentiles and the diagnostics the perf trajectory needs to
+    attribute wins (decode window, per-step decode latency)."""
     from deeplearning_cfn_tpu.serve.bench import run_serve_bench
 
     rec = run_serve_bench(num_requests=4, slots=2, max_new_tokens=4,
@@ -467,3 +636,26 @@ def test_serve_bench_record_contract():
     assert rec["p50_latency_s"] is not None
     assert rec["ttft_p95_s"] is not None
     assert rec["engine_steps"] > 0
+    assert rec["decode_window"] >= 1
+    assert rec["decode_steps"] > 0
+    assert rec["step_latency_p50_s"] is not None
+    assert rec["step_latency_p95_s"] is not None
+    assert rec["queue_wait_p50_s"] is not None
+
+
+def test_cli_bench_serve_smoke_emits_contract_record(capsys):
+    """`bench --serve --smoke` is the CI fast mode: it must always emit a
+    valid BENCH-contract record, so the serving bench cannot silently rot."""
+    from deeplearning_cfn_tpu.cli.main import main
+
+    assert main(["bench", "--serve", "--smoke"]) == 0
+    out = capsys.readouterr().out.strip()
+    rec = json.loads(out.splitlines()[-1])
+    assert {"metric", "value", "unit", "vs_baseline", "mfu",
+            "measured"} <= set(rec)
+    assert rec["metric"] == "serve_tiny_nmt_tokens_per_sec"
+    assert rec["measured"] is True
+    assert rec["smoke"] is True
+    assert rec["value"] is not None and rec["value"] > 0
+    assert rec["decode_window"] >= 1
+    assert rec["step_latency_p50_s"] is not None
