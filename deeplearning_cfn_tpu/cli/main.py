@@ -403,12 +403,14 @@ def _cmd_bench(args) -> int:
         for gb in batches:
             line = run_bench(preset=args.preset, steps=args.steps,
                              global_batch=gb,
-                             include_input=args.with_input)
+                             include_input=args.with_input,
+                             step_window=args.step_window)
             print(json.dumps(line), flush=True)
         return 0
     line = run_bench(preset=args.preset, steps=args.steps,
                      global_batch=args.global_batch,
-                     include_input=args.with_input)
+                     include_input=args.with_input,
+                     step_window=args.step_window)
     print(json.dumps(line))
     return 0
 
@@ -987,6 +989,9 @@ def build_parser() -> argparse.ArgumentParser:
     be.add_argument("--with-input", action="store_true",
                     help="also report value_with_input (host pipeline + "
                          "transfer in the timed loop)")
+    be.add_argument("--step-window", type=int, default=1,
+                    help="fuse K train steps per device dispatch (bench "
+                         "the fast path's scan program; 1 = per-step)")
     be.add_argument("--collectives", action="store_true",
                     help="run the collectives microbench (nccl-tests role) "
                          "instead of a training-step bench")

@@ -1,0 +1,31 @@
+"""Version-compat accessors for jax APIs that moved between releases.
+
+One place to absorb jax API migrations so call sites stay on the modern
+spelling. Today that is ``shard_map``: new jax exposes ``jax.shard_map``
+with a ``check_vma`` kwarg; 0.4.x ships it as
+``jax.experimental.shard_map.shard_map`` where the same knob is spelled
+``check_rep``. Everything in-tree that maps a function over the mesh goes
+through :func:`shard_map` below.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` on jax versions that have it, else the
+    ``jax.experimental.shard_map`` spelling with ``check_vma`` translated
+    to its old name ``check_rep``. ``check_vma=None`` leaves the jax
+    default in place on either version."""
+    if hasattr(jax, "shard_map"):
+        kwargs = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    kwargs = {} if check_vma is None else {"check_rep": check_vma}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

@@ -13,7 +13,7 @@ import os
 import pickle
 import queue
 import threading
-from typing import Callable, Dict, Iterator, Optional
+from typing import Any, Callable, Dict, Iterator, Optional
 
 import jax
 import numpy as np
@@ -306,6 +306,86 @@ def _thread_prefetch(it: Iterator[Batch], depth: int) -> Iterator[Batch]:
                 q.get_nowait()
         except queue.Empty:
             pass
+
+
+class DevicePrefetcher:
+    """Double-buffered host→device staging on a background thread.
+
+    Layered on :func:`_thread_prefetch`'s host-side pipeline: ``transform``
+    (typically ``Trainer.device_batch``) runs on the worker thread, so the
+    host→device transfer of batch N+1 overlaps the device compute of batch
+    N and the consuming step loop never blocks on ``device_put``. ``depth``
+    bounds how many device-resident batches are staged ahead (2 = classic
+    double buffering; deeper pins more HBM for no extra overlap).
+
+    ``close()`` (also the iterator-abandon path via ``__del__``) stops the
+    worker even when it is blocked on a full queue, joins it, then closes
+    the wrapped iterator — no thread is left pinning staged batches for the
+    rest of the process.
+    """
+
+    def __init__(self, it: Iterator[Batch], transform: Callable[[Batch], Any],
+                 depth: int = 2):
+        self._it = it
+        self._transform = transform
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._sentinel = object()
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _work(self):
+        try:
+            for item in self._it:
+                if self._stop.is_set():
+                    return
+                if not self._put(self._transform(item)):
+                    return
+            self._put(self._sentinel)
+        except BaseException as e:  # propagate staging crashes to consumer
+            self._put(("__prefetch_error__", e))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._sentinel:
+            raise StopIteration
+        if isinstance(item, tuple) and len(item) == 2 and \
+                item[0] == "__prefetch_error__":
+            raise RuntimeError("device prefetch worker crashed") from item[1]
+        return item
+
+    def close(self):
+        self._stop.set()
+        # Unblock a worker stuck in put(); it re-checks the event and exits.
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        # Join BEFORE closing the wrapped iterator: generator.close() on a
+        # generator mid-next() in another thread raises ValueError.
+        self._thread.join(timeout=10.0)
+        close = getattr(self._it, "close", None)
+        if close is not None:
+            try:
+                close()
+            except ValueError:  # worker outlived the join timeout
+                pass
+
+    def __del__(self):
+        self._stop.set()
 
 
 # ---------------------------------------------------------------------------

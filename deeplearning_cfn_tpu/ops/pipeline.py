@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..compat import shard_map
+
 PyTree = Any
 
 
@@ -116,7 +118,7 @@ def gpipe(
             out)
 
     x_spec = P(batch_spec)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(axis_name), x_spec),
         out_specs=x_spec,

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..compat import shard_map
+
 _NEG_INF = -1e30
 
 
@@ -216,7 +218,7 @@ def ring_attention_sharded(
     spec = P(batch_axis, None, axis_name, None)
     fn = partial(ring_attention, axis_name=axis_name, causal=causal,
                  sm_scale=sm_scale)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )

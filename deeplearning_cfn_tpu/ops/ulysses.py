@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..compat import shard_map
 from .attention import fused_attention
 
 
@@ -84,7 +85,7 @@ def ulysses_attention_sharded(
     spec = P(batch_axis, None, axis_name, None)
     fn = partial(ulysses_attention, axis_name=axis_name, causal=causal,
                  sm_scale=sm_scale, implementation=implementation)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )

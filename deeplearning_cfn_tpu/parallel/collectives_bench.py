@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat import shard_map
 from ..runtime.profiling import StepTimer
 
 
@@ -78,7 +79,7 @@ def run_collectives_bench(
             jax.jit,
             out_shardings=NamedSharding(
                 mesh, P() if op == "all_gather" else spec))
-        @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec,),
+        @functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
                            out_specs=P() if op == "all_gather" else spec,
                            check_vma=False)
         def timed(x, fn=fn):

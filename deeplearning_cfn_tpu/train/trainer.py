@@ -66,6 +66,22 @@ class _LazyShardedJit:
         return self._ensure(state).lower(state, batch, rng)
 
 
+def _plan_window(step: int, num_steps: int, window: int,
+                 cadences, boundaries=()) -> int:
+    """Largest k <= ``window`` such that the half-open step range
+    [step, step+k) crosses no cadence multiple and no explicit boundary
+    except at its end — so log/eval/hook cadences and trace start/stop
+    always land exactly on a window edge, never inside a fused scan."""
+    k = min(window, num_steps - step)
+    for c in cadences:
+        if c and c > 0:
+            k = min(k, c - step % c)
+    for b in boundaries:
+        if b > step:
+            k = min(k, b - step)
+    return max(k, 1)
+
+
 class Trainer:
     """Owns the compiled train/eval steps and the step loop.
 
@@ -110,12 +126,21 @@ class Trainer:
             raise ValueError(
                 f"train.grad_accum_unroll must be auto|scan|unroll, got "
                 f"{cfg.train.grad_accum_unroll!r}")
+        if cfg.train.step_window < 1:
+            raise ValueError(
+                f"train.step_window must be >= 1, got "
+                f"{cfg.train.step_window}")
+        if cfg.train.device_prefetch < 0:
+            raise ValueError(
+                f"train.device_prefetch must be >= 0, got "
+                f"{cfg.train.device_prefetch}")
         self.spatial_dim = spatial_dim
         # Which batch keys the spatial shard applies to (None = any array
         # with >=4 dims). Detection restricts it to "image" — its mask
         # targets are also 4-D but their dim 1 is a box count, not height.
         self.spatial_keys = spatial_keys
         self._train_step = None
+        self._window_step = None
         self._eval_step = None
         self._donate = donate
         # Post-aggregation metric transforms (task.eval_derived): computed
@@ -159,7 +184,15 @@ class Trainer:
 
     # -- compiled steps -----------------------------------------------------
 
-    def _build_train_step(self):
+    def _train_step_fn(self):
+        """The raw (unjitted) per-step function. Shared by the per-step
+        jit and the fused step-window scan so the two paths trace the
+        SAME per-step jaxpr — that sharing, plus ``fold_in(rng,
+        state.step)`` keyed off the in-carry step counter, pins the
+        window path to the per-step loop's exact math and RNG streams.
+        (XLA may still fuse a while-loop body differently than the
+        straight-line program, so trajectories agree to float precision
+        — ~1 ulp/step — not necessarily bit-for-bit.)"""
         tx = self.tx
         loss_fn = self.loss_fn
         ema_decay = self.cfg.train.ema_decay
@@ -265,8 +298,36 @@ class Trainer:
             metrics["grad_norm"] = optax.global_norm(grads)
             return new_state, metrics
 
+        return train_step
+
+    def _build_train_step(self):
         donate = (0,) if self._donate else ()
-        return _LazyShardedJit(train_step, donate)
+        return _LazyShardedJit(self._train_step_fn(), donate)
+
+    def _build_window_step(self):
+        step_fn = self._train_step_fn()
+
+        def window_step(state: TrainState, batches: Tuple[Batch, ...],
+                        rng: jax.Array):
+            # Stack the k device-staged batches inside the jitted program
+            # (device-side concat — each batch was already put with its
+            # target sharding, so the stack inherits it on dims 1+), then
+            # scan the SAME per-step body the per-step jit runs. The body
+            # folds rng with the in-carry step counter, so every step of
+            # the window draws its canonical RNG stream and the loss
+            # trajectory matches k per-step calls step for step (to float
+            # precision — XLA's loop-body codegen can differ from the
+            # straight-line program by ~1 ulp).
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *batches)
+
+            def body(st, b):
+                return step_fn(st, b, rng)
+
+            return jax.lax.scan(body, state, stacked)
+
+        donate = (0,) if self._donate else ()
+        return _LazyShardedJit(window_step, donate)
 
     def _build_eval_step(self):
         loss_fn = self.loss_fn
@@ -285,6 +346,16 @@ class Trainer:
         if self._train_step is None:
             self._train_step = self._build_train_step()
         return self._train_step
+
+    @property
+    def window_step(self):
+        """Fused multi-step program: ``(state, (batch,)*k, rng) ->
+        (state, stacked metrics [k])``. jit re-specializes per distinct k
+        (the tuple length is part of the pytree structure), so a clamped
+        remainder window compiles its own program once."""
+        if self._window_step is None:
+            self._window_step = self._build_window_step()
+        return self._window_step
 
     @property
     def eval_step(self):
@@ -309,11 +380,34 @@ class Trainer:
         start_step: Optional[int] = None,
         trace_dir: Optional[str] = None,
         trace_steps: int = 0,
+        hook_every: int = 1,
     ) -> TrainState:
         """The step loop. Dispatches async; only syncs on metrics at
         ``log_every`` boundaries so device compute and host input prep overlap
         (the reference achieved this with MXNet/TF's async engines; here it is
         jax dispatch + explicit sync points).
+
+        With ``train.step_window`` K > 1, K consecutive steps run as ONE
+        fused ``window_step`` program (a lax.scan over K device-staged
+        batches) — K fewer dispatches and zero host round-trips between
+        the fused steps, with the per-step loop's exact math and RNG
+        streams (trajectories agree to float precision; see
+        ``_train_step_fn``). Windows are clamped so log/eval/hook cadences and
+        trace start/stop always land on a window edge; hooks fire at
+        every window boundary, and ``hook_every`` names the cadence (in
+        steps) hooks must land on exactly — run.py passes the checkpoint
+        cadence. K = 1 (the default) is the per-step loop, unchanged.
+
+        With ``train.device_prefetch`` d > 0, host batches are staged to
+        device (``device_batch``) on a background thread, d deep, so
+        host→device transfer overlaps the previous window's compute.
+
+        The first dispatched program carries trace+compile cost; the loop
+        syncs on it, reports the wall time as ``compile_s`` on the first
+        logged record, and restarts the throughput window — so the first
+        ``examples_per_sec`` measures post-compile steps only (a boundary
+        with no post-compile steps yet omits the throughput keys rather
+        than report a compile-polluted rate).
 
         ``trace_dir`` + ``trace_steps``: capture a jax.profiler trace of
         ``trace_steps`` hot-loop steps (skipping the first, compile-heavy
@@ -339,8 +433,35 @@ class Trainer:
         window_start = time.perf_counter()
         window_examples = 0
         last: Optional[tuple] = None
+        prev: Optional[tuple] = None
+        realized_thru = step - 1  # last step index already logged
         last_realized: Optional[Dict[str, float]] = None
         gb = self.cfg.train.global_batch
+        K = self.cfg.train.step_window
+        # Cadences a fused window must not straddle. hook_every only
+        # binds when there are hooks to land; log_every=0 still logs
+        # every step (the boundary test uses max(log_every, 1)).
+        cadences = [max(log_every, 1)]
+        if eval_iter_fn is not None and eval_every > 0:
+            cadences.append(eval_every)
+        if hooks and hook_every > 0:
+            cadences.append(hook_every)
+        compile_s: Optional[float] = None
+        first_sync_done = False
+
+        batch_iter = None  # device-staging wrapper, when enabled
+        if self.cfg.train.device_prefetch > 0:
+            from ..data.pipeline import DevicePrefetcher
+
+            batch_iter = DevicePrefetcher(
+                train_iter, self.device_batch,
+                depth=self.cfg.train.device_prefetch)
+
+            def next_batch():
+                return next(batch_iter)
+        else:
+            def next_batch():
+                return self.device_batch(next(train_iter))
 
         # finally: stop a prefetched iterator's worker thread (and free its
         # buffered batches) instead of abandoning it blocked on a full
@@ -350,43 +471,93 @@ class Trainer:
                 if step == trace_start:
                     trace_stack.enter_context(profiler_trace(trace_dir))
                     tracing = True
-                batch = next(train_iter)
-                dev_batch = self.device_batch(batch)
-                state, metrics = self.train_step(state, dev_batch, rng)
-                last = (step, metrics)
-                window_examples += gb
-                step += 1
+                k = 1 if K == 1 else _plan_window(
+                    step, num_steps, K, cadences,
+                    (trace_start, trace_stop))
+                if k == 1:
+                    # Per-step program — also the remainder path when a
+                    # window clamps to one step.
+                    state, metrics = self.train_step(
+                        state, next_batch(), rng)
+                else:
+                    batches = tuple(next_batch() for _ in range(k))
+                    state, metrics = self.window_step(state, batches, rng)
+                prev, last = last, (step + k - 1, metrics)
+                window_examples += gb * k
+                step += k
                 if tracing and step >= trace_stop:
                     jax.block_until_ready(metrics)
                     trace_stack.close()
                     tracing = False
 
-                if step % max(log_every, 1) == 0 or step >= num_steps:
-                    # Sync point: realize the latest step's metrics.
-                    last_step, last_metrics = last
-                    realized = {
-                        k: float(v) for k, v in
-                        jax.device_get(last_metrics).items()
-                    }
-                    elapsed = time.perf_counter() - window_start
-                    realized["examples_per_sec"] = \
-                        window_examples / max(elapsed, 1e-9)
-                    realized["examples_per_sec_per_device"] = (
-                        realized["examples_per_sec"] / self.mesh.devices.size
-                    )
-                    realized["step"] = last_step + 1
-                    if metrics_writer is not None:
-                        metrics_writer.write(realized)
+                if not first_sync_done:
+                    # The first dispatch traced + compiled; sync on it,
+                    # record compile_s, and restart the throughput window
+                    # so the first logged examples_per_sec is honest.
+                    jax.block_until_ready(metrics)
+                    compile_s = time.perf_counter() - window_start
                     window_start = time.perf_counter()
                     window_examples = 0
-                    last_realized = realized
+                    first_sync_done = True
                     if watchdog is not None:
+                        watchdog.beat()
+
+                if step % max(log_every, 1) == 0 or step >= num_steps:
+                    # Sync point. The per-step path realizes the latest
+                    # step. Windowed runs realize the PREVIOUS window —
+                    # it has certainly finished on device (its successor
+                    # was dispatched after it), so the host never stalls
+                    # on in-flight compute; records lag one boundary, and
+                    # the final boundary flushes both pending windows.
+                    at_end = step >= num_steps
+                    to_realize = []
+                    if K == 1:
+                        to_realize.append(last)
+                    else:
+                        if prev is not None and prev[0] > realized_thru:
+                            to_realize.append(prev)
+                        if at_end and last[0] > realized_thru:
+                            to_realize.append(last)
+                    first_write = True
+                    for w_end, w_metrics in to_realize:
+                        realized = {
+                            k_: float(np.asarray(v).reshape(-1)[-1])
+                            for k_, v in
+                            jax.device_get(w_metrics).items()
+                        }
+                        if first_write:
+                            # Throughput covers everything dispatched
+                            # since the last written boundary; the final
+                            # flush's second record carries step metrics
+                            # only.
+                            elapsed = time.perf_counter() - window_start
+                            if window_examples > 0:
+                                realized["examples_per_sec"] = \
+                                    window_examples / max(elapsed, 1e-9)
+                                realized["examples_per_sec_per_device"] = (
+                                    realized["examples_per_sec"]
+                                    / self.mesh.devices.size
+                                )
+                            window_start = time.perf_counter()
+                            window_examples = 0
+                            first_write = False
+                        realized["step"] = w_end + 1
+                        if compile_s is not None:
+                            realized["compile_s"] = compile_s
+                            compile_s = None
+                        if metrics_writer is not None:
+                            metrics_writer.write(realized)
+                        realized_thru = w_end
+                        last_realized = realized
+                    if to_realize and watchdog is not None:
                         # device_get above proved device-side progress.
                         watchdog.beat()
 
-                # Hooks run every step (checkpoint cadence must not couple
-                # to log cadence); metrics arg is the last realized window,
-                # if any.
+                # Hooks run at every window boundary — every step when
+                # K = 1, and window planning lands them exactly on
+                # hook_every multiples otherwise (checkpoint cadence must
+                # not couple to log cadence); metrics arg is the last
+                # realized window, if any.
                 t_hooks = time.perf_counter()
                 for hook in hooks:
                     hook(step, state, last_realized)
@@ -423,9 +594,12 @@ class Trainer:
             if watchdog is not None:
                 watchdog.stop()
             trace_stack.close()  # no-op unless exited mid-capture
-            close = getattr(train_iter, "close", None)
-            if close is not None:
-                close()
+            if batch_iter is not None:
+                batch_iter.close()  # joins its worker, closes train_iter
+            else:
+                close = getattr(train_iter, "close", None)
+                if close is not None:
+                    close()
 
     def evaluate(self, state: TrainState, eval_iter: Iterator[Batch],
                  max_steps: int = 0, watchdog=None) -> Dict[str, float]:

@@ -209,3 +209,47 @@ def test_bench_child_measures_on_cpu():
     for name in ("start", "import_jax", "backend_init", "devices_ok",
                  "build", "first_compile", "warmup", "timed", "done"):
         assert f"s {name}" in proc.stderr, (name, proc.stderr[-2000:])
+
+
+def test_finalize_green_keeps_forced_cpu_measurement(monkeypatch):
+    """A run the wrapper itself forced to JAX_PLATFORMS=cpu (no accelerator
+    platform would initialize) is a real, labeled measurement: measured
+    stays true with the numeric value, and forced_platform marks that it
+    must not be read as a chip number."""
+    w = _load_wrapper()
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    rec = w._finalize_green(
+        {"value": 12.3, "vs_baseline": 0.03, "mfu": 0.0,
+         "device_kind": "cpu"},
+        alive=False, probe_note="probe: backend_init hung >40s",
+        forced_cpu=True)
+    assert rec["measured"] is True
+    assert rec["value"] == 12.3
+    assert rec["forced_platform"] == "cpu"
+    assert "cpu_fallback_value" not in rec
+
+
+@pytest.mark.slow
+def test_wrapper_forces_cpu_when_accelerator_dead(tmp_path):
+    """End-to-end on a host with no accelerator: the probe reads jax's
+    silent CPU fallback as a dead plugin, the cpu probe comes up, and the
+    attempts run forced to JAX_PLATFORMS=cpu — a green, labeled CPU
+    measurement instead of five rounds of measured=false (r05)."""
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=1",
+               DLCFN_BENCH_PRESET="cifar10_resnet20",
+               DLCFN_BENCH_STEPS="3", DLCFN_BENCH_WARMUP="1",
+               DLCFN_BENCH_GLOBAL_BATCH="32",
+               DLCFN_BENCH_TOTAL_BUDGET_S="400",
+               DLCFN_BENCH_ARTIFACT_DIR=str(tmp_path))
+    env.pop("JAX_PLATFORMS", None)  # accelerator-less: probe must go red
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+        capture_output=True, text=True, timeout=500, cwd=REPO_ROOT, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["measured"] is True, rec
+    assert rec["forced_platform"] == "cpu"
+    assert rec["value"] > 0
+    assert rec["device_kind"] == "cpu"
+    assert "forced JAX_PLATFORMS=cpu" in rec["probe"]

@@ -156,3 +156,70 @@ def test_padded_eval_tail_multi_process():
             seen.extend(b["x"][b["eval_mask"] > 0].tolist())
     assert len(set(steps)) == 1  # lockstep
     assert sorted(seen) == list(range(70))
+
+
+def test_device_prefetcher_yields_all_in_order():
+    """DevicePrefetcher is order-preserving and runs its transform on the
+    worker thread (the device_batch role in the train fast path)."""
+    import threading
+
+    from deeplearning_cfn_tpu.data.pipeline import DevicePrefetcher
+
+    src = (({"x": np.full((2,), i, np.float32)}) for i in range(20))
+    worker_ids = set()
+
+    def transform(b):
+        worker_ids.add(threading.get_ident())
+        return {"x": b["x"] + 1}
+
+    pf = DevicePrefetcher(src, transform, depth=2)
+    got = [int(b["x"][0]) for b in pf]
+    assert got == [i + 1 for i in range(20)]
+    assert worker_ids and threading.get_ident() not in worker_ids
+    pf.close()
+    assert not pf._thread.is_alive()
+
+
+def test_device_prefetcher_close_unblocks_full_queue():
+    """close() mid-stream: the worker may be blocked on a full queue and
+    the wrapped generator mid-next — both must shut down cleanly (no
+    daemon thread left staging batches for the rest of the process), and
+    the generator's close() must run."""
+    import threading
+
+    from deeplearning_cfn_tpu.data.pipeline import DevicePrefetcher
+
+    closed = threading.Event()
+
+    def gen():
+        try:
+            i = 0
+            while True:
+                yield {"x": np.full((2,), i, np.float32)}
+                i += 1
+        finally:
+            closed.set()
+
+    pf = DevicePrefetcher(gen(), lambda b: b, depth=1)
+    assert int(next(pf)["x"][0]) == 0  # worker is running and producing
+    pf.close()  # queue is full again by now; worker blocked in put()
+    assert not pf._thread.is_alive()
+    assert closed.wait(timeout=5.0)
+    # Idempotent: a second close (e.g. fit's finally after an explicit
+    # close) must not raise.
+    pf.close()
+
+
+def test_device_prefetcher_propagates_transform_errors():
+    from deeplearning_cfn_tpu.data.pipeline import DevicePrefetcher
+
+    src = iter([{"x": np.zeros(2)}])
+
+    def bad(b):
+        raise ValueError("staging exploded")
+
+    pf = DevicePrefetcher(src, bad, depth=2)
+    with pytest.raises(RuntimeError, match="device prefetch worker"):
+        next(pf)
+    pf.close()
+    assert not pf._thread.is_alive()
