@@ -5,11 +5,16 @@ from .checkpoint import (  # noqa: F401
     restore_checkpoint,
     rollback_checkpoints,
     save_checkpoint,
+    sweep_uncommitted,
 )
 from .store import (  # noqa: F401
     GcsStore,
     MemoryObjectStore,
     PosixStore,
+    RetryingStore,
+    RetryPolicy,
     Store,
+    is_retriable,
     open_store,
+    retry_policy_from_config,
 )

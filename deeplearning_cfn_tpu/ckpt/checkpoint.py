@@ -41,7 +41,7 @@ import jax
 import numpy as np
 
 from ..utils.trees import flatten_with_names
-from .store import Store, open_store
+from .store import RetryPolicy, Store, open_store
 
 PyTree = Any
 
@@ -210,6 +210,34 @@ def rollback_checkpoints(directory: StoreOrPath, step: int) -> List[int]:
     return sorted(deleted)
 
 
+def sweep_uncommitted(directory: StoreOrPath) -> List[int]:
+    """Delete every ``step_<N>`` directory that has no ``COMMIT`` marker
+    and return the sorted list of swept steps.
+
+    These are torn commits: a process died between writing shard objects
+    and the commit rendezvous (or the rendezvous timed out). They are
+    invisible to restore (which only sees committed steps) but they leak
+    storage and — worse — a later save of the SAME step would write into a
+    directory still holding the dead attempt's ``manifest_p*``/``DONE_p*``
+    files, breaking two-phase-commit atomicity. Call this only when no
+    save can be in flight (the resume path calls it at startup, before the
+    first save is dispatched, and only from process 0).
+    """
+    store = open_store(directory)
+    swept = []
+    for name in store.list_subdirs(""):
+        if not name.startswith("step_"):
+            continue
+        try:
+            s = int(name[len("step_"):])
+        except ValueError:
+            continue
+        if not store.exists(f"{name}/{_COMMIT}"):
+            store.delete_prefix(f"{name}/")
+            swept.append(s)
+    return sorted(swept)
+
+
 # -- restore ----------------------------------------------------------------
 
 
@@ -364,11 +392,14 @@ class CheckpointManager:
     destination may be a POSIX directory, a gs:// url, or a Store."""
 
     def __init__(self, directory: StoreOrPath, every_steps: int = 0,
-                 keep: int = 3, async_write: bool = True):
+                 keep: int = 3, async_write: bool = True,
+                 retry: Optional[RetryPolicy] = None):
         self.directory = directory
         # Resolve once: for gs:// paths this constructs the authenticated
         # client a single time, not per save on the training cadence.
-        self.store = open_store(directory)
+        # ``retry`` wraps it in a RetryingStore, so every save/restore/list
+        # below inherits the transient-fault policy.
+        self.store = open_store(directory, retry=retry)
         self.every_steps = every_steps
         self.keep = keep
         self.async_write = async_write
@@ -405,6 +436,16 @@ class CheckpointManager:
             if step is None:
                 return None, None
         return restore_checkpoint(self.store, target, step, shardings)
+
+    def store_retries(self) -> int:
+        """Transient-fault retries absorbed by the store so far (0 when the
+        store has no retry layer) — surfaced into train/serve metrics."""
+        return int(getattr(self.store, "retries_total", 0))
+
+    def sweep_orphans(self) -> List[int]:
+        """Sweep torn (uncommitted) step directories; see
+        :func:`sweep_uncommitted` for the safety contract."""
+        return sweep_uncommitted(self.store)
 
     def wait(self):
         for t in self._threads:

@@ -22,11 +22,13 @@ Keys are ``/``-separated paths relative to the store root, e.g.
 
 from __future__ import annotations
 
+import dataclasses
 import io
 import os
 import shutil
 import threading
-from typing import Dict, List, Union
+import time
+from typing import Callable, Dict, List, Optional, Union
 
 import numpy as np
 
@@ -82,18 +84,56 @@ class Store:
 class PosixStore(Store):
     """Filesystem-backed store; atomicity via tmp-file + ``os.replace``.
     Works on local disk and on POSIX-rename shared filesystems (NFS/EFS
-    equivalents) — the reference's durability model."""
+    equivalents) — the reference's durability model.
 
-    def __init__(self, root: str):
+    Tmp names carry a pid+thread suffix: on a SHARED filesystem several
+    writers (ranks on different hosts re-saving the same step after a
+    restart, or the async-save thread racing a sweep) may target the same
+    key, and a fixed ``path + ".tmp"`` would have them truncating each
+    other's half-written file before one of them renames it. Stale tmp
+    files (a writer SIGKILLed mid-write) are swept on store open once they
+    are older than ``sweep_tmp_age_s`` — young ones may belong to a live
+    writer on another host and are left alone.
+    """
+
+    # Old enough that no live writer can still own it (a single object
+    # write takes seconds, not an hour), young enough that crash debris
+    # doesn't accumulate across restarts.
+    STALE_TMP_AGE_S = 3600.0
+
+    def __init__(self, root: str, sweep_tmp_age_s: float = STALE_TMP_AGE_S):
         self.root = root
+        self._sweep_stale_tmp(sweep_tmp_age_s)
 
     def _path(self, key: str) -> str:
         return os.path.join(self.root, *key.split("/"))
 
+    @staticmethod
+    def _is_tmp(name: str) -> bool:
+        return name.endswith(".tmp") or name.endswith(".tmp.npz")
+
+    def _tmp_suffix(self) -> str:
+        return f".{os.getpid()}.{threading.get_ident()}.tmp"
+
+    def _sweep_stale_tmp(self, max_age_s: float) -> None:
+        if max_age_s <= 0 or not os.path.isdir(self.root):
+            return
+        cutoff = time.time() - max_age_s
+        for dirpath, _, files in os.walk(self.root):
+            for name in files:
+                if not self._is_tmp(name):
+                    continue
+                full = os.path.join(dirpath, name)
+                try:
+                    if os.path.getmtime(full) < cutoff:
+                        os.remove(full)
+                except OSError:
+                    pass  # raced another sweeper/writer; harmless
+
     def put_bytes(self, key: str, data: bytes) -> None:
         path = self._path(key)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
+        tmp = path + self._tmp_suffix()
         with open(tmp, "wb") as fh:
             fh.write(data)
         os.replace(tmp, path)
@@ -117,7 +157,7 @@ class PosixStore(Store):
             return out
         for dirpath, _, files in os.walk(walk_root):
             for name in files:
-                if name.endswith(".tmp") or name.endswith(".tmp.npz"):
+                if self._is_tmp(name):
                     continue
                 full = os.path.join(dirpath, name)
                 key = os.path.relpath(full, self.root).replace(os.sep, "/")
@@ -148,7 +188,8 @@ class PosixStore(Store):
         # Stream straight to disk instead of staging the whole npz in RAM.
         path = self._path(key)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp.npz"  # savez appends .npz unless present
+        # savez appends .npz unless present, hence the trailing .npz.
+        tmp = path + self._tmp_suffix() + ".npz"
         np.savez(tmp, **arrays)
         os.replace(tmp, path)
 
@@ -270,12 +311,187 @@ class GcsStore(Store):
         return self.url
 
 
-def open_store(directory_or_store: Union[str, Store]) -> Store:
+# -- retrying I/O -----------------------------------------------------------
+#
+# Every store operation above is one-shot: a single transient GCS 503 (or an
+# NFS hiccup) mid-save would kill the whole run even though the launcher
+# would then restart it and lose minutes of work for a fault that a 2-second
+# retry absorbs. RetryingStore is the policy layer: transient errors retry
+# with exponential backoff and DETERMINISTIC jitter (reproducible schedules
+# — no wall-clock randomness, mirroring runtime/faults.py), permanent errors
+# fail fast, and the retry counts are surfaced so operators see flakiness
+# in metrics before it becomes an outage.
+
+# HTTP codes GCS documents as retriable (plus 408/429 throttling).
+GCS_TRANSIENT_CODES = frozenset({408, 429, 500, 502, 503, 504})
+
+# google-cloud exception class names treated as transient without importing
+# the library (it is an optional dependency — see GcsStore's lazy import).
+_GCS_TRANSIENT_NAMES = frozenset({
+    "TooManyRequests", "InternalServerError", "BadGateway",
+    "ServiceUnavailable", "GatewayTimeout", "DeadlineExceeded",
+    "TransportError", "RetryError",
+})
+
+# Checked BEFORE the OSError branch: FileNotFoundError IS an OSError, but a
+# missing object is a protocol answer ("not committed yet"), not a fault —
+# retrying it would turn every latest_checkpoint() probe into a backoff
+# loop. ValueError/KeyError are corrupt-input classifications from the
+# checkpoint layer itself.
+_FATAL_TYPES = (FileNotFoundError, NotADirectoryError, IsADirectoryError,
+                ValueError, KeyError, NotImplementedError)
+
+
+def is_retriable(exc: BaseException) -> bool:
+    """Transient (worth retrying) vs. permanent (fail fast now)."""
+    if isinstance(exc, _FATAL_TYPES):
+        return False
+    code = getattr(exc, "code", None)
+    if isinstance(code, int):
+        return code in GCS_TRANSIENT_CODES
+    if isinstance(exc, (OSError, ConnectionError, TimeoutError)):
+        return True
+    return type(exc).__name__ in _GCS_TRANSIENT_NAMES
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Retry schedule for store operations.
+
+    ``max_attempts`` counts total tries (1 = no retry). Backoff is
+    ``backoff_s * 2**retry`` capped at ``backoff_max_s``, stretched by a
+    deterministic jitter in ``[0, jitter]`` derived from the (op sequence,
+    attempt) pair — decorrelates concurrent rank retries without any
+    wall-clock randomness. ``op_timeout_s`` bounds one logical operation
+    across ALL its attempts (0 = unbounded): a save must fail in bounded
+    time so the launcher's restart path can take over.
+    """
+
+    max_attempts: int = 3
+    backoff_s: float = 0.5
+    backoff_max_s: float = 8.0
+    jitter: float = 0.1
+    op_timeout_s: float = 60.0
+
+    def backoff(self, retry_index: int, salt: int = 0) -> float:
+        base = min(self.backoff_s * (2.0 ** retry_index), self.backoff_max_s)
+        # Weyl-style hash of (salt, retry) → [0, 1): deterministic jitter.
+        h = (salt * 2654435761 + retry_index * 40503 + 12345) % 997
+        return base * (1.0 + self.jitter * (h / 996.0))
+
+
+def retry_policy_from_config(ckpt_cfg) -> Optional["RetryPolicy"]:
+    """Build a policy from CheckpointConfig's retry_* knobs (duck-typed so
+    store.py stays independent of config.py); None = retries disabled."""
+    attempts = int(getattr(ckpt_cfg, "retry_attempts", 1) or 1)
+    if attempts <= 1:
+        return None
+    return RetryPolicy(
+        max_attempts=attempts,
+        backoff_s=float(getattr(ckpt_cfg, "retry_backoff_s", 0.5)),
+        backoff_max_s=float(getattr(ckpt_cfg, "retry_backoff_max_s", 8.0)),
+        jitter=float(getattr(ckpt_cfg, "retry_jitter", 0.1)),
+        op_timeout_s=float(getattr(ckpt_cfg, "retry_timeout_s", 60.0)),
+    )
+
+
+class RetryingStore(Store):
+    """Store wrapper applying a :class:`RetryPolicy` to every operation.
+
+    Counters are public surface: ``retries_total`` (sleep-then-retry
+    events), ``retries_by_op``, and ``gave_up`` (retriable errors that
+    exhausted the budget) feed the train/serve metrics streams.
+    """
+
+    def __init__(self, inner: Store, policy: RetryPolicy,
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic):
+        self.inner = inner
+        self.policy = policy
+        self._sleep = sleep
+        self._clock = clock
+        self.retries_total = 0
+        self.retries_by_op: Dict[str, int] = {}
+        self.gave_up = 0
+        self._op_seq = 0
+        self._lock = threading.Lock()
+
+    def _call(self, op: str, fn: Callable):
+        with self._lock:
+            self._op_seq += 1
+            salt = self._op_seq
+        p = self.policy
+        deadline = (self._clock() + p.op_timeout_s) \
+            if p.op_timeout_s > 0 else None
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:
+                attempt += 1
+                retriable = is_retriable(e)
+                out_of_time = deadline is not None \
+                    and self._clock() >= deadline
+                if not retriable or attempt >= p.max_attempts or out_of_time:
+                    if retriable:
+                        with self._lock:
+                            self.gave_up += 1
+                    raise
+                delay = p.backoff(attempt - 1, salt=salt)
+                if deadline is not None:
+                    delay = min(delay, max(deadline - self._clock(), 0.0))
+                with self._lock:
+                    self.retries_total += 1
+                    self.retries_by_op[op] = \
+                        self.retries_by_op.get(op, 0) + 1
+                self._sleep(delay)
+
+    def put_bytes(self, key, data):
+        return self._call("put_bytes",
+                          lambda: self.inner.put_bytes(key, data))
+
+    def put_npz(self, key, arrays):
+        return self._call("put_npz",
+                          lambda: self.inner.put_npz(key, arrays))
+
+    def get_bytes(self, key):
+        return self._call("get_bytes", lambda: self.inner.get_bytes(key))
+
+    def get_npz(self, key):
+        return self._call("get_npz", lambda: self.inner.get_npz(key))
+
+    def exists(self, key):
+        return self._call("exists", lambda: self.inner.exists(key))
+
+    def list(self, prefix=""):
+        return self._call("list", lambda: self.inner.list(prefix))
+
+    def list_subdirs(self, prefix=""):
+        return self._call("list_subdirs",
+                          lambda: self.inner.list_subdirs(prefix))
+
+    def delete_prefix(self, prefix):
+        return self._call("delete_prefix",
+                          lambda: self.inner.delete_prefix(prefix))
+
+    def describe(self):
+        return f"retrying({self.inner.describe()})"
+
+
+def open_store(directory_or_store: Union[str, Store],
+               retry: Optional[RetryPolicy] = None) -> Store:
     """Resolve a checkpoint destination: a Store passes through; a
-    ``gs://`` url opens GCS; anything else is a POSIX directory."""
+    ``gs://`` url opens GCS; anything else is a POSIX directory. With
+    ``retry``, the resolved store is wrapped in a :class:`RetryingStore`
+    (idempotent: an already-retrying store is never double-wrapped)."""
     if isinstance(directory_or_store, Store):
-        return directory_or_store
-    if isinstance(directory_or_store, str) and \
+        store = directory_or_store
+    elif isinstance(directory_or_store, str) and \
             directory_or_store.startswith("gs://"):
-        return GcsStore(directory_or_store)
-    return PosixStore(directory_or_store)
+        store = GcsStore(directory_or_store)
+    else:
+        store = PosixStore(directory_or_store)
+    if retry is not None and retry.max_attempts > 1 \
+            and not isinstance(store, RetryingStore):
+        store = RetryingStore(store, retry)
+    return store

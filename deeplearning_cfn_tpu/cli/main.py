@@ -672,15 +672,37 @@ def _cmd_metrics(args) -> int:
     return 0
 
 
+def _cli_store(args):
+    """Resolve the ckpt-verb target, honoring --retry-attempts: >1 wraps
+    the store in the same RetryingStore policy training uses, so flaky
+    object-store reads don't fail one-shot CLI inspections either.
+    Preserves committed_steps' wrong-path error for local directories
+    (which the Store indirection would otherwise skip)."""
+    import os as _os
+
+    from ..ckpt import RetryPolicy, open_store
+
+    if isinstance(args.dir, str) and not args.dir.startswith("gs://") \
+            and not _os.path.isdir(args.dir):
+        raise FileNotFoundError(f"no such checkpoint directory: {args.dir}")
+    retry = None
+    if getattr(args, "retry_attempts", 1) > 1:
+        retry = RetryPolicy(max_attempts=args.retry_attempts,
+                            backoff_s=args.retry_backoff)
+    return open_store(args.dir, retry=retry)
+
+
 def _cmd_ckpt_list(args) -> int:
     from ..ckpt import committed_steps
 
     try:
-        steps = committed_steps(args.dir)
+        store = _cli_store(args)
+        steps = committed_steps(store)
     except FileNotFoundError as e:
         print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
         return 1
-    print(json.dumps({"directory": args.dir, "committed_steps": steps}))
+    print(json.dumps({"directory": args.dir, "committed_steps": steps,
+                      "store_retries": getattr(store, "retries_total", 0)}))
     return 0
 
 
@@ -688,7 +710,7 @@ def _cmd_ckpt_rollback(args) -> int:
     from ..ckpt import rollback_checkpoints
 
     try:
-        deleted = rollback_checkpoints(args.dir, args.step)
+        deleted = rollback_checkpoints(_cli_store(args), args.step)
     except FileNotFoundError as e:
         print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
         return 1
@@ -1032,8 +1054,18 @@ def build_parser() -> argparse.ArgumentParser:
     ck = sub.add_parser("ckpt", help="checkpoint inspection / rollback")
     cksub = ck.add_subparsers(dest="ckpt_cmd", required=True)
 
+    def _add_retry_flags(p):
+        p.add_argument("--retry-attempts", type=int, default=1,
+                       help="total store-I/O tries per operation; >1 "
+                            "enables transient-fault retries with "
+                            "exponential backoff (default 1 = off)")
+        p.add_argument("--retry-backoff", type=float, default=0.5,
+                       help="base backoff seconds between retries "
+                            "(default 0.5)")
+
     ckl = cksub.add_parser("list", help="list committed checkpoint steps")
     ckl.add_argument("dir", help="checkpoint directory (or gs:// url)")
+    _add_retry_flags(ckl)
     ckl.set_defaults(fn=_cmd_ckpt_list)
 
     ckr = cksub.add_parser(
@@ -1043,6 +1075,7 @@ def build_parser() -> argparse.ArgumentParser:
     ckr.add_argument("dir", help="checkpoint directory (or gs:// url)")
     ckr.add_argument("--step", type=int, required=True,
                      help="committed step to roll back to")
+    _add_retry_flags(ckr)
     ckr.set_defaults(fn=_cmd_ckpt_rollback)
 
     # data -------------------------------------------------------------------

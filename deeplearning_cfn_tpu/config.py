@@ -202,6 +202,18 @@ class CheckpointConfig:
     keep: int = 3
     async_write: bool = True
     resume: bool = True  # auto-resume from latest on startup
+    # Store-I/O retry policy (ckpt/store.py:RetryingStore): transient
+    # faults (GCS 5xx/429, OSError) retry with exponential backoff +
+    # deterministic jitter; permanent errors (FileNotFoundError,
+    # ValueError) fail fast. retry_attempts counts TOTAL tries per op;
+    # <=1 disables the retry layer entirely. retry_timeout_s bounds one
+    # logical op across all its attempts so a dead store converts into
+    # the process death the launcher's restart path handles.
+    retry_attempts: int = 3
+    retry_backoff_s: float = 0.5
+    retry_backoff_max_s: float = 8.0
+    retry_jitter: float = 0.1
+    retry_timeout_s: float = 60.0
     # NOTE deliberately no restore-step knob here: rolling back is the
     # imperative `dlcfn-tpu ckpt rollback` verb. A persisted rollback
     # setting would re-delete new progress on every relaunch.

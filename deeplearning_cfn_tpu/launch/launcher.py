@@ -23,6 +23,7 @@ import time
 from typing import Callable, Dict, IO, List, Optional, Sequence
 
 from ..runtime.cluster import ClusterSpec, cluster_env
+from ..runtime.watchdog import HANG_EXIT_CODE
 
 
 class Transport:
@@ -72,12 +73,26 @@ class SshTransport(Transport):
                                 start_new_session=True)
 
 
+def classify_attempt(codes: List[int]) -> str:
+    """``ok`` | ``hang`` | ``crash`` for one attempt's exit codes. A hang is
+    the watchdog's deliberate exit (runtime/watchdog.py, code 89) — a wedged
+    collective, not a fault in the program — and operators triage the two
+    very differently, so the distinction is recorded per attempt."""
+    if all(c == 0 for c in codes):
+        return "ok"
+    if any(c == HANG_EXIT_CODE for c in codes):
+        return "hang"
+    return "crash"
+
+
 @dataclasses.dataclass
 class JobResult:
     success: bool
     restarts: int
     exit_codes: List[int]
     log_dir: str
+    # One entry per attempt, "ok" | "hang" | "crash" (classify_attempt).
+    attempt_outcomes: List[str] = dataclasses.field(default_factory=list)
 
 
 class _HostProc:
@@ -123,7 +138,11 @@ class JobLauncher:
                    ) -> List[_HostProc]:
         procs = []
         for i, host in enumerate(spec.hosts):
-            env = {**cluster_env(spec, i), **extra_env}
+            # Workers learn which attempt they are (0-based; the chaos
+            # harness keys fault arming off it). extra_env second, so an
+            # explicit caller value still wins.
+            env = {**cluster_env(spec, i),
+                   "DLCFN_ATTEMPT": str(attempt), **extra_env}
             log_path = os.path.join(log_dir,
                                     f"attempt{attempt}-host{i}.log")
             log_file = open(log_path, "ab", buffering=0)
@@ -215,12 +234,21 @@ class JobLauncher:
         os.makedirs(log_dir, exist_ok=True)
         extra_env = extra_env or {}
         attempt = 0
+        outcomes: List[str] = []
         while True:
             codes = self._run_attempt(spec, argv, log_dir, attempt,
                                       extra_env, cwd, on_failure)
-            if all(c == 0 for c in codes):
-                return JobResult(True, attempt, codes, log_dir)
+            outcome = classify_attempt(codes)
+            outcomes.append(outcome)
+            if outcome == "ok":
+                return JobResult(True, attempt, codes, log_dir,
+                                 attempt_outcomes=outcomes)
+            print(f"[dlcfn-tpu] attempt {attempt} failed ({outcome}): "
+                  f"exit codes {codes}"
+                  + (" — watchdog hang exit, wedged collective suspected"
+                     if outcome == "hang" else ""))
             if attempt >= self.max_restarts:
-                return JobResult(False, attempt, codes, log_dir)
+                return JobResult(False, attempt, codes, log_dir,
+                                 attempt_outcomes=outcomes)
             attempt += 1
             time.sleep(min(2.0 ** attempt, 10.0))  # backoff before retry

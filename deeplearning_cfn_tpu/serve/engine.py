@@ -211,8 +211,8 @@ class Engine:
             req = self.queue.submit(src_ids, budget, beam_size=beam_size,
                                     deadline_s=deadline_s,
                                     request_id=request_id)
-        except OverloadError:
-            self.metrics.record_reject()
+        except OverloadError as e:
+            self.metrics.record_reject(e.retry_after_s)
             raise
         self.metrics.record_submit()
         return req

@@ -15,7 +15,8 @@ from typing import Optional, Tuple
 
 import jax
 
-from ..ckpt import CheckpointManager, latest_checkpoint
+from ..ckpt import CheckpointManager, latest_checkpoint, \
+    retry_policy_from_config
 from ..config import ExperimentConfig, MeshConfig
 from .engine import Engine
 
@@ -47,14 +48,18 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
             f"serve drives decode_step_at on the transformer_nmt family")
     variables = task.init(jax.random.PRNGKey(cfg.train.seed))
     _, ckpt_dir = _workdir_and_ckpt_dir(cfg)
-    if latest_checkpoint(ckpt_dir) is None:
+    # One manager (and one retry-wrapped store) for the probe AND the
+    # restore, so transient faults during load are absorbed by the same
+    # policy training uses — and counted for the serve metrics below.
+    manager = CheckpointManager(
+        ckpt_dir, retry=retry_policy_from_config(cfg.checkpoint))
+    if latest_checkpoint(manager.store) is None:
         if not allow_init:
             raise FileNotFoundError(
                 f"no committed checkpoint in {ckpt_dir} — train first, or "
                 f"pass allow_init for a random-weights smoke engine")
         params, at_step = variables["params"], -1
     else:
-        manager = CheckpointManager(ckpt_dir)
         restored, at_step = manager.restore_or_none(
             {"params": variables["params"]}, step=step)
         params = restored["params"]
@@ -72,4 +77,5 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
         if length_penalty is None else length_penalty,
         decode_window=decode_window,
         clock=clock)
+    engine.metrics.ckpt_load_retries = manager.store_retries()
     return engine, bpe, int(at_step)

@@ -63,6 +63,11 @@ class ServeMetrics:
         self.busy_time_s = 0.0
         self._occupancy_sum = 0.0
         self.last_queue_depth = 0
+        # Robustness surface: store retries absorbed while loading the
+        # checkpoint (set by serve/loader.py), and the most recent
+        # retry-after hint handed out with an overload rejection.
+        self.ckpt_load_retries = 0
+        self.last_retry_after_s: Optional[float] = None
         # Distributions.
         self.ttft_s: List[float] = []
         self.latency_s: List[float] = []
@@ -74,8 +79,10 @@ class ServeMetrics:
     def record_submit(self) -> None:
         self.submitted += 1
 
-    def record_reject(self) -> None:
+    def record_reject(self, retry_after_s: Optional[float] = None) -> None:
         self.rejected += 1
+        if retry_after_s is not None:
+            self.last_retry_after_s = retry_after_s
 
     def record_admit(self, queue_wait_s: Optional[float] = None) -> None:
         self.admitted += 1
@@ -149,6 +156,8 @@ class ServeMetrics:
             "serve_slot_occupancy": self.mean_slot_occupancy,
             "serve_tokens_generated": self.tokens_generated,
             "serve_tokens_per_sec": self.tokens_per_sec,
+            "serve_ckpt_load_retries": self.ckpt_load_retries,
+            "serve_retry_after_hint_s": self.last_retry_after_s,
             "serve_queue_wait_p50_s": percentile(self.queue_wait_s, 50),
             "serve_queue_wait_p95_s": percentile(self.queue_wait_s, 95),
             "serve_ttft_p50_s": percentile(self.ttft_s, 50),

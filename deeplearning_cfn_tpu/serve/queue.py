@@ -25,12 +25,15 @@ via :meth:`pop_ready`.
 
 from __future__ import annotations
 
+import collections
 import enum
 import itertools
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import List, Optional
+
+from .metrics import percentile
 
 
 class RequestState(enum.Enum):
@@ -42,13 +45,22 @@ class RequestState(enum.Enum):
 
 
 class OverloadError(RuntimeError):
-    """Bounded queue is full — the caller must back off or shed load."""
+    """Bounded queue is full — the caller must back off or shed load.
 
-    def __init__(self, depth: int, max_depth: int):
+    ``retry_after_s`` is the p50 of recent queue waits (submit → admit):
+    the queue's own estimate of how long backing off for one "turn" takes.
+    None when the queue has admitted nothing recently.
+    """
+
+    def __init__(self, depth: int, max_depth: int,
+                 retry_after_s: Optional[float] = None):
+        hint = "retry later" if retry_after_s is None \
+            else f"retry in ~{retry_after_s:.3f}s"
         super().__init__(
-            f"request queue full ({depth}/{max_depth}); retry later")
+            f"request queue full ({depth}/{max_depth}); {hint}")
         self.depth = depth
         self.max_depth = max_depth
+        self.retry_after_s = retry_after_s
 
 
 @dataclass
@@ -112,6 +124,10 @@ class RequestQueue:
         self._pending: List[Request] = []
         self._by_id: dict = {}
         self._auto_id = itertools.count()
+        # Recent admission waits (submit → pop_ready), feeding the
+        # OverloadError retry-after hint. Bounded so the hint tracks
+        # CURRENT load, not the whole process history.
+        self._recent_waits = collections.deque(maxlen=64)
 
     @property
     def depth(self) -> int:
@@ -131,7 +147,9 @@ class RequestQueue:
         now = self._clock()
         with self._lock:
             if len(self._pending) >= self.max_depth:
-                raise OverloadError(len(self._pending), self.max_depth)
+                raise OverloadError(
+                    len(self._pending), self.max_depth,
+                    retry_after_s=percentile(list(self._recent_waits), 50))
             rid = request_id if request_id is not None \
                 else f"req-{next(self._auto_id)}"
             if rid in self._by_id:
@@ -161,6 +179,7 @@ class RequestQueue:
                     req.state = RequestState.EXPIRED
                     req.finished_at = now
                     continue
+                self._recent_waits.append(now - req.submitted_at)
                 return req
             return None
 

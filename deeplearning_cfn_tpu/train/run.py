@@ -14,8 +14,9 @@ from typing import Dict, Optional
 
 import jax
 
-from ..ckpt import CheckpointManager
+from ..ckpt import CheckpointManager, retry_policy_from_config
 from ..config import ExperimentConfig
+from ..runtime.faults import chaos_kill_hook_from_env
 from ..data import build_pipeline
 from ..metrics import MetricsWriter
 from ..parallel.mesh import build_mesh, describe, local_batch_size
@@ -99,7 +100,8 @@ def run_eval(
         ema=cfg.train.ema_decay > 0,
         shard_opt_state=False,
     )
-    manager = CheckpointManager(ckpt_dir)
+    manager = CheckpointManager(ckpt_dir,
+                                retry=retry_policy_from_config(cfg.checkpoint))
     restored, at_step = manager.restore_or_none(state, step=step)
     state = restored
     trainer = _build_trainer(cfg, task, tx, mesh)
@@ -149,8 +151,17 @@ def run_experiment(
     ckpt_every = cfg.checkpoint.every_steps or steps_per_epoch
     manager = CheckpointManager(ckpt_dir, every_steps=ckpt_every,
                                 keep=cfg.checkpoint.keep,
-                                async_write=cfg.checkpoint.async_write)
+                                async_write=cfg.checkpoint.async_write,
+                                retry=retry_policy_from_config(cfg.checkpoint))
     if cfg.checkpoint.resume:
+        # Sweep torn step dirs left by a crashed attempt BEFORE anything
+        # else touches the store: no save is in flight yet, and a later
+        # re-save of a swept step must start from an empty directory.
+        if jax.process_index() == 0:
+            orphans = manager.sweep_orphans()
+            if orphans:
+                print(f"[dlcfn-tpu] swept {len(orphans)} uncommitted "
+                      f"checkpoint dir(s): steps {orphans}")
         restored, at_step = manager.restore_or_none(state)
         if restored is not None:
             state = restored
@@ -169,6 +180,14 @@ def run_experiment(
     def ckpt_hook(step, st, _metrics):
         manager.save(step, st)
 
+    # ckpt_hook first, chaos kill (test harness, env-gated) after it: the
+    # SIGKILL then lands between a dispatched save and the next one — the
+    # torn-commit window the recovery contract must survive.
+    hooks = [ckpt_hook]
+    chaos_hook = chaos_kill_hook_from_env()
+    if chaos_hook is not None:
+        hooks.append(chaos_hook)
+
     eval_every = cfg.train.eval_every_steps or steps_per_epoch
     state = trainer.fit(
         state,
@@ -178,7 +197,7 @@ def run_experiment(
         rng=train_rng,
         eval_iter_fn=lambda: eval_pipe.one_epoch(),
         eval_every=eval_every,
-        hooks=(ckpt_hook,),
+        hooks=tuple(hooks),
         # Step windows must land exactly on the save cadence — the
         # manager's own should_save(step) check only fires on multiples.
         hook_every=ckpt_every,
@@ -193,6 +212,7 @@ def run_experiment(
 
     final = _final_eval(cfg, task, trainer, state, eval_pipe)
     writer.write({"step": int(state.step),
+                  "ckpt_store_retries": manager.store_retries(),
                   **{f"final_eval_{k}": v for k, v in final.items()}})
     writer.close()
     del data_rng

@@ -1,0 +1,151 @@
+"""Crash-recovery: resume must be step-exact and torn state must be swept.
+
+Two tiers:
+- the default-suite tests run the recovery logic in process (interrupt a
+  real training run, plant a torn checkpoint dir, resume, compare per-step
+  metrics float-exactly) — tier-1-safe, no subprocesses;
+- the ``chaos``-marked test drives the full launcher harness
+  (launch/chaos.py): a worker subprocess SIGKILLs itself mid-run at the
+  planned step, JobLauncher restarts it, and the resumed trajectory must
+  match an uninterrupted control run exactly.
+"""
+
+import json
+import os
+
+import pytest
+
+from deeplearning_cfn_tpu.config import ExperimentConfig, apply_overrides
+from deeplearning_cfn_tpu.presets import get_preset
+
+# Cheap deterministic CPU config (the test_trainer tiny-cfg recipe).
+TINY_OVERRIDES = [
+    "train.global_batch=32",
+    "train.log_every_steps=1",
+    "train.eval_every_steps=1000000",
+    "data.num_train_examples=256",
+    "data.num_eval_examples=64",
+    "train.eval_batch=32",
+    "data.prefetch=0",
+    "schedule.name=constant",
+    "schedule.base_lr=0.1",
+    "schedule.warmup_epochs=0",
+]
+
+
+def _cfg(workdir, steps=6, ckpt_every=2) -> ExperimentConfig:
+    cfg = get_preset("cifar10_resnet20")
+    apply_overrides(cfg, [
+        f"workdir={workdir}",
+        f"train.steps={steps}",
+        f"checkpoint.every_steps={ckpt_every}",
+        "checkpoint.async_write=false",
+        *TINY_OVERRIDES,
+    ])
+    return cfg
+
+
+def _step_losses(workdir):
+    """step → [loss, ...] from every per-step record in metrics.jsonl."""
+    path = os.path.join(workdir, "cifar10_resnet20", "metrics.jsonl")
+    out = {}
+    with open(path) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if "step" in rec and "loss" in rec:
+                out.setdefault(int(rec["step"]), []).append(rec["loss"])
+    return out
+
+
+def test_inprocess_interrupt_resume_is_step_exact(tmp_workdir, devices):
+    """Interrupt a run at a committed step, plant a torn checkpoint dir,
+    resume: the orphan is swept, the trajectory matches an uninterrupted
+    control run float-exactly, and retry counts appear in metrics."""
+    from deeplearning_cfn_tpu.train.run import run_experiment
+
+    base_dir = os.path.join(tmp_workdir, "base")
+    chaos_dir = os.path.join(tmp_workdir, "chaos")
+
+    run_experiment(_cfg(base_dir))  # uninterrupted control
+
+    # "Crash" at step 4: the interrupted run stops there with step 4
+    # committed (the cadence save), like a worker dying right after a
+    # checkpoint boundary.
+    run_experiment(_cfg(chaos_dir), max_steps=4)
+
+    # Plant the torn debris a real mid-save death leaves behind: a step
+    # dir with shard objects but no COMMIT.
+    ckpt_dir = os.path.join(chaos_dir, "cifar10_resnet20", "ckpt")
+    torn = os.path.join(ckpt_dir, "step_00000099")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "shards_p0.npz"), "wb") as fh:
+        fh.write(b"half-written garbage")
+
+    run_experiment(_cfg(chaos_dir))  # restart: sweep, resume 4 → 6
+
+    assert not os.path.exists(torn), "orphaned uncommitted dir not swept"
+    base = _step_losses(base_dir)
+    chaos = _step_losses(chaos_dir)
+    assert set(chaos) == set(base)
+    for step, losses in sorted(chaos.items()):
+        for loss in losses:  # overlap steps recorded by both attempts
+            assert loss == base[step][0], \
+                f"step {step}: resumed loss {loss!r} != control " \
+                f"{base[step][0]!r}"
+    # Step 4 was committed before the interrupt; 6 by the resumed run.
+    from deeplearning_cfn_tpu.ckpt import committed_steps
+
+    assert 6 in committed_steps(ckpt_dir)
+    # The retry counter rides the final metrics record (0 here — no faults).
+    path = os.path.join(chaos_dir, "cifar10_resnet20", "metrics.jsonl")
+    finals = [json.loads(line) for line in open(path)
+              if "ckpt_store_retries" in line]
+    assert finals and all(r["ckpt_store_retries"] == 0 for r in finals)
+
+
+def test_chaos_hook_arming_contract(monkeypatch):
+    """The SIGKILL hook only arms on attempt 0 with the env set — a
+    restarted attempt must run to completion."""
+    from deeplearning_cfn_tpu.runtime.faults import (
+        ATTEMPT_ENV,
+        CHAOS_KILL_ENV,
+        chaos_kill_hook_from_env,
+    )
+
+    monkeypatch.delenv(CHAOS_KILL_ENV, raising=False)
+    monkeypatch.delenv(ATTEMPT_ENV, raising=False)
+    assert chaos_kill_hook_from_env() is None  # unarmed by default
+
+    monkeypatch.setenv(CHAOS_KILL_ENV, "4")
+    assert chaos_kill_hook_from_env() is not None  # armed, attempt 0
+
+    monkeypatch.setenv(ATTEMPT_ENV, "1")
+    assert chaos_kill_hook_from_env() is None  # restarted attempt: never
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_sigkill_restart_resumes_step_exact(tmp_path):
+    """The full contract, end to end: a real worker subprocess SIGKILLs
+    itself right after the step-4 checkpoint dispatch, JobLauncher
+    restarts it, and the resumed run is step-exact vs. the control."""
+    from deeplearning_cfn_tpu.launch.chaos import run_crash_recovery
+
+    report = run_crash_recovery(
+        str(tmp_path),
+        preset="cifar10_resnet20",
+        overrides=TINY_OVERRIDES,
+        total_steps=8,
+        kill_at_step=4,
+        ckpt_every=2,
+        max_restarts=2,
+    )
+    assert report.baseline_result.success, report.baseline_result
+    assert report.chaos_result.success, report.chaos_result
+    assert report.chaos_result.restarts >= 1  # the kill really happened
+    assert report.chaos_result.attempt_outcomes[0] == "crash"
+    assert report.chaos_result.attempt_outcomes[-1] == "ok"
+    assert report.resumed_from is not None  # restart announced its resume
+    assert report.parity_ok, report.mismatches
+    assert report.uncommitted_after == []  # torn dirs swept on resume
+    assert report.ok

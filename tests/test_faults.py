@@ -1,0 +1,350 @@
+"""Fault injection + retrying store I/O: the storage half of the recovery
+contract. Covers the deterministic FaultPlan/FaultInjectionStore layer, the
+RetryingStore policy (transient absorbed, permanent fails fast, budgets
+bounded), and the two-phase commit protocol's behavior against torn-commit
+states on both PosixStore and MemoryObjectStore."""
+
+import os
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from deeplearning_cfn_tpu.ckpt import (
+    CheckpointManager,
+    MemoryObjectStore,
+    PosixStore,
+    RetryPolicy,
+    RetryingStore,
+    is_retriable,
+    latest_checkpoint,
+    open_store,
+    restore_checkpoint,
+    retry_policy_from_config,
+    rollback_checkpoints,
+    save_checkpoint,
+    sweep_uncommitted,
+)
+from deeplearning_cfn_tpu.config import CheckpointConfig
+from deeplearning_cfn_tpu.runtime.faults import (
+    FaultInjectionStore,
+    FaultPlan,
+    FaultSpec,
+    InjectedFatalError,
+    InjectedTransientError,
+    StoreCrashed,
+)
+
+
+def _tree():
+    return {"params": {"w": jnp.arange(6.0).reshape(2, 3)},
+            "step": jnp.asarray(5, jnp.int32)}
+
+
+def _store_factories(tmp_path):
+    return {
+        "posix": lambda: PosixStore(str(tmp_path / "posix")),
+        "memory": MemoryObjectStore,
+    }
+
+
+# -- FaultPlan / FaultInjectionStore ----------------------------------------
+
+
+def test_fault_spec_first_n_is_per_site():
+    plan = FaultPlan([FaultSpec(op="put", kind="transient", first_n=2)])
+    store = FaultInjectionStore(MemoryObjectStore(), plan)
+    for key in ("a", "b"):  # each key is its own site: 2 failures each
+        for _ in range(2):
+            with pytest.raises(InjectedTransientError):
+                store.put_bytes(key, b"x")
+        store.put_bytes(key, b"x")  # third call succeeds
+    assert store.injected == {"transient": 4}
+    assert store.inner.get_bytes("a") == b"x"
+
+
+def test_fault_spec_at_calls_and_key_substring():
+    plan = FaultPlan([FaultSpec(op="put", key="DONE", kind="transient",
+                                at_calls=(1,))])
+    store = FaultInjectionStore(MemoryObjectStore(), plan)
+    store.put_bytes("step_1/DONE_p0", b"1")  # site call 0: passes
+    with pytest.raises(InjectedTransientError):
+        store.put_bytes("step_1/DONE_p0", b"1")  # site call 1: fires
+    store.put_bytes("step_1/COMMIT", b"1")  # key mismatch: never fires
+
+
+def test_probability_faults_are_seeded_deterministic():
+    def run(seed):
+        plan = FaultPlan([FaultSpec(op="put", kind="transient",
+                                    probability=0.5)], seed=seed)
+        store = FaultInjectionStore(MemoryObjectStore(), plan)
+        fired = []
+        for i in range(20):
+            try:
+                store.put_bytes(f"k{i}", b"x")
+                fired.append(False)
+            except InjectedTransientError:
+                fired.append(True)
+        return fired
+
+    assert run(7) == run(7)  # same seed → identical schedule
+    assert any(run(7)) and not all(run(7))
+
+
+def test_latency_fault_calls_sleep_then_delegates():
+    slept = []
+    plan = FaultPlan([FaultSpec(op="get", kind="latency", latency_s=1.5)])
+    store = FaultInjectionStore(MemoryObjectStore(), plan,
+                                sleep=slept.append)
+    store.inner.put_bytes("k", b"v")
+    assert store.get_bytes("k") == b"v"
+    assert slept == [1.5]
+
+
+def test_crash_fault_kills_the_store_permanently():
+    plan = FaultPlan.crash_before_commit()
+    store = FaultInjectionStore(MemoryObjectStore(), plan)
+    store.put_bytes("step_1/DONE_p0", b"1")
+    with pytest.raises(StoreCrashed):
+        store.put_bytes("step_1/COMMIT", b"1")
+    assert store.crashed
+    # A dead process never writes again — even non-matching ops raise.
+    with pytest.raises(StoreCrashed):
+        store.get_bytes("step_1/DONE_p0")
+    assert not store.inner.exists("step_1/COMMIT")
+
+
+def test_unknown_fault_kind_rejected():
+    with pytest.raises(ValueError):
+        FaultSpec(kind="gremlins")
+
+
+# -- retry classification / policy ------------------------------------------
+
+
+def test_retriable_classification():
+    assert is_retriable(OSError("io"))
+    assert is_retriable(ConnectionResetError())
+    assert is_retriable(TimeoutError())
+    assert is_retriable(InjectedTransientError("injected"))
+    # Fatal beats the OSError base class: FileNotFoundError IS an OSError.
+    assert not is_retriable(FileNotFoundError("gone"))
+    assert not is_retriable(ValueError("corrupt"))
+    assert not is_retriable(InjectedFatalError("injected"))
+    assert not is_retriable(KeyError("leaf"))
+
+    class Gcs503(Exception):
+        code = 503
+
+    class Gcs404(Exception):
+        code = 404
+
+    class ServiceUnavailable(Exception):  # name-based fallback
+        pass
+
+    assert is_retriable(Gcs503())
+    assert not is_retriable(Gcs404())
+    assert is_retriable(ServiceUnavailable())
+
+
+def test_backoff_is_deterministic_capped_and_jittered():
+    p = RetryPolicy(backoff_s=1.0, backoff_max_s=4.0, jitter=0.1)
+    assert p.backoff(2, salt=9) == p.backoff(2, salt=9)
+    for i in range(8):
+        base = min(2.0 ** i, 4.0)
+        assert base <= p.backoff(i, salt=3) <= base * 1.1
+    # Different salts decorrelate concurrent retriers.
+    assert p.backoff(0, salt=1) != p.backoff(0, salt=2)
+
+
+def test_retry_policy_from_config():
+    assert retry_policy_from_config(CheckpointConfig(retry_attempts=1)) is None
+    p = retry_policy_from_config(CheckpointConfig(retry_attempts=5,
+                                                  retry_backoff_s=0.25))
+    assert p.max_attempts == 5 and p.backoff_s == 0.25
+
+
+# -- RetryingStore ----------------------------------------------------------
+
+
+def test_retrying_store_absorbs_transients_with_visible_counts():
+    faulty = FaultInjectionStore(
+        MemoryObjectStore(), FaultPlan.transient_puts(failures_per_put=2))
+    slept = []
+    store = RetryingStore(faulty, RetryPolicy(max_attempts=3),
+                          sleep=slept.append)
+    store.put_bytes("a", b"1")
+    store.put_bytes("b", b"2")
+    assert store.inner.inner.get_bytes("a") == b"1"
+    assert store.retries_total == 4 and len(slept) == 4
+    assert store.retries_by_op == {"put_bytes": 4}
+    assert store.gave_up == 0
+
+
+def test_retrying_store_fails_fast_on_permanent_errors():
+    faulty = FaultInjectionStore(MemoryObjectStore(),
+                                 FaultPlan.permanent_puts())
+    slept = []
+    store = RetryingStore(faulty, RetryPolicy(max_attempts=5),
+                          sleep=slept.append)
+    with pytest.raises(InjectedFatalError):
+        store.put_bytes("a", b"1")
+    assert slept == []  # no backoff burned on a classified-fatal error
+    assert store.retries_total == 0
+    assert faulty.op_counts["put_bytes"] == 1  # exactly one attempt
+
+
+def test_retrying_store_exhausts_budget_then_reraises():
+    faulty = FaultInjectionStore(
+        MemoryObjectStore(),
+        FaultPlan([FaultSpec(op="put", kind="transient")]))  # always fails
+    store = RetryingStore(faulty, RetryPolicy(max_attempts=3),
+                          sleep=lambda d: None)
+    with pytest.raises(InjectedTransientError):
+        store.put_bytes("a", b"1")
+    assert faulty.op_counts["put_bytes"] == 3
+    assert store.retries_total == 2 and store.gave_up == 1
+
+
+def test_retrying_store_per_op_deadline():
+    clock = {"t": 0.0}
+    faulty = FaultInjectionStore(
+        MemoryObjectStore(),
+        FaultPlan([FaultSpec(op="put", kind="transient")]))
+    store = RetryingStore(
+        faulty, RetryPolicy(max_attempts=100, op_timeout_s=5.0),
+        sleep=lambda d: clock.__setitem__("t", clock["t"] + d),
+        clock=lambda: clock["t"])
+    with pytest.raises(InjectedTransientError):
+        store.put_bytes("a", b"1")
+    # Bounded by the deadline, far below the 100-attempt budget.
+    assert faulty.op_counts["put_bytes"] < 20
+
+
+def test_open_store_wraps_once():
+    inner = MemoryObjectStore()
+    wrapped = open_store(inner, retry=RetryPolicy())
+    assert isinstance(wrapped, RetryingStore)
+    again = open_store(wrapped, retry=RetryPolicy())
+    assert again is wrapped  # no double wrap
+    assert open_store(inner) is inner  # no policy → untouched
+
+
+def test_checkpoint_commits_through_flaky_store_with_retry_metrics():
+    """The acceptance scenario: 2 transient failures per put, a full
+    two-phase checkpoint save commits anyway, retry counts visible."""
+    faulty = FaultInjectionStore(
+        MemoryObjectStore(), FaultPlan.transient_puts(failures_per_put=2))
+    manager = CheckpointManager(
+        faulty, every_steps=1, async_write=False,
+        retry=RetryPolicy(max_attempts=3, backoff_s=0.0, jitter=0.0))
+    state = _tree()
+    manager.save(5, state)
+    assert latest_checkpoint(manager.store) == 5
+    assert manager.store_retries() >= 2  # ≥2 per faulted put, surfaced
+    restored, step = manager.restore_or_none(state)
+    assert step == 5
+
+
+def test_checkpoint_fails_fast_through_permanently_broken_store():
+    faulty = FaultInjectionStore(MemoryObjectStore(),
+                                 FaultPlan.permanent_puts())
+    manager = CheckpointManager(faulty, every_steps=1, async_write=False,
+                                retry=RetryPolicy(max_attempts=5))
+    t0 = time.monotonic()
+    with pytest.raises(InjectedFatalError):
+        manager.save(5, _tree())
+    assert time.monotonic() - t0 < 2.0  # no retry backoff was burned
+    assert manager.store_retries() == 0
+
+
+# -- torn-commit states (both store kinds) ----------------------------------
+
+
+@pytest.mark.parametrize("kind", ["posix", "memory"])
+def test_crash_before_done_leaves_invisible_sweepable_state(tmp_path, kind):
+    inner = _store_factories(tmp_path)[kind]()
+    faulty = FaultInjectionStore(inner, FaultPlan.crash_before_done())
+    with pytest.raises(StoreCrashed):
+        save_checkpoint(faulty, 3, _tree(), async_write=False)
+    # Shards + manifests are durable, no DONE, no COMMIT.
+    assert any("shards_p0" in k for k in inner.list("step_00000003/"))
+    assert not inner.exists("step_00000003/DONE_p0")
+    assert latest_checkpoint(inner) is None
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(inner, _tree())
+    assert sweep_uncommitted(inner) == [3]
+    assert inner.list("step_00000003/") == []
+
+
+@pytest.mark.parametrize("kind", ["posix", "memory"])
+def test_crash_before_commit_rolls_back_cleanly(tmp_path, kind):
+    inner = _store_factories(tmp_path)[kind]()
+    save_checkpoint(inner, 1, _tree(), async_write=False)  # healthy commit
+    faulty = FaultInjectionStore(inner, FaultPlan.crash_before_commit())
+    with pytest.raises(StoreCrashed):
+        save_checkpoint(faulty, 2, _tree(), async_write=False)
+    # Step 2 has every per-process object and marker except COMMIT.
+    assert inner.exists("step_00000002/DONE_p0")
+    assert not inner.exists("step_00000002/COMMIT")
+    assert latest_checkpoint(inner) == 1
+    # rollback to the committed step deletes the torn one too.
+    assert rollback_checkpoints(inner, 1) == [2]
+    assert inner.list("step_00000002/") == []
+    restored, step = restore_checkpoint(inner, _tree())
+    assert step == 1
+
+
+@pytest.mark.parametrize("kind", ["posix", "memory"])
+def test_partial_ranks_torn_state(tmp_path, kind):
+    """Emulate a 2-process save where rank 1 died before its DONE marker:
+    the checkpoint must stay invisible and sweepable."""
+    inner = _store_factories(tmp_path)[kind]()
+    key = "step_00000004"
+    faulty = FaultInjectionStore(
+        inner, FaultPlan([FaultSpec(op="put", key="DONE_p1", kind="crash")]))
+    # Rank 0's full contribution...
+    faulty.put_bytes(f"{key}/manifest.json", b"{}")
+    faulty.put_bytes(f"{key}/manifest_p0.json", b"{}")
+    faulty.put_bytes(f"{key}/DONE_p0", b"4")
+    # ...rank 1 dies on its marker; COMMIT is never reached.
+    faulty.put_bytes(f"{key}/manifest_p1.json", b"{}")
+    with pytest.raises(StoreCrashed):
+        faulty.put_bytes(f"{key}/DONE_p1", b"4")
+    assert latest_checkpoint(inner) is None
+    assert sweep_uncommitted(inner) == [4]
+    assert inner.list(f"{key}/") == []
+
+
+# -- PosixStore tmp hygiene (satellite) --------------------------------------
+
+
+def test_posix_tmp_names_are_writer_unique(tmp_path):
+    store = PosixStore(str(tmp_path))
+    suffix = store._tmp_suffix()
+    assert str(os.getpid()) in suffix and suffix.endswith(".tmp")
+    store.put_bytes("step_1/COMMIT", b"1")
+    store.put_npz("step_1/shards.npz", {"w": jnp.zeros(2)})
+    # No tmp debris after successful puts, and list() never shows any.
+    leftovers = [n for _, _, fs in os.walk(tmp_path) for n in fs
+                 if ".tmp" in n]
+    assert leftovers == []
+
+
+def test_posix_stale_tmp_swept_on_open_fresh_kept(tmp_path):
+    root = tmp_path / "ckpt"
+    sub = root / "step_00000001"
+    sub.mkdir(parents=True)
+    stale = sub / "shards_p0.npz.123.456.tmp"
+    stale.write_bytes(b"half-written")
+    old = time.time() - 7200
+    os.utime(stale, (old, old))
+    fresh = sub / "COMMIT.789.1011.tmp"  # young: maybe a live writer
+    fresh.write_bytes(b"inflight")
+
+    store = PosixStore(str(root))
+    assert not stale.exists()
+    assert fresh.exists()
+    # tmp files are invisible to the protocol either way.
+    assert all(not store._is_tmp(k.rsplit("/", 1)[-1])
+               for k in store.list(""))

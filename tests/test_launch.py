@@ -117,6 +117,49 @@ def test_restart_budget_exhausted(tmp_path):
     assert not result.success
     assert result.restarts == 1
     assert set(result.exit_codes) == {3}
+    assert result.attempt_outcomes == ["crash", "crash"]
+
+
+def test_hang_vs_crash_classified_per_attempt(tmp_path):
+    """The watchdog's deliberate exit 89 is recorded as 'hang', anything
+    else nonzero as 'crash' — per attempt, so operators can tell a wedged
+    collective from a real fault without reading rank logs."""
+    from deeplearning_cfn_tpu.launch.launcher import classify_attempt
+    from deeplearning_cfn_tpu.runtime.watchdog import HANG_EXIT_CODE
+
+    assert classify_attempt([0, 0]) == "ok"
+    assert classify_attempt([0, 1]) == "crash"
+    assert classify_attempt([HANG_EXIT_CODE, 0]) == "hang"
+    # A hang wins over a concurrent crash: the watchdog exit is the
+    # diagnosis, the other rank's death is collateral.
+    assert classify_attempt([HANG_EXIT_CODE, 1]) == "hang"
+
+    launcher = JobLauncher(transport=LocalTransport(), max_restarts=0,
+                           tail_rank0=False)
+    result = launcher.run(
+        _spec(1), _py(f"import sys; sys.exit({HANG_EXIT_CODE})"),
+        str(tmp_path / "logs"))
+    assert not result.success
+    assert result.attempt_outcomes == ["hang"]
+
+
+def test_launcher_exports_attempt_index(tmp_path):
+    """Workers see DLCFN_ATTEMPT per attempt (the chaos harness keys its
+    fault arming off it): here the worker hangs-exits only on attempt 0,
+    so outcomes read hang → ok."""
+    from deeplearning_cfn_tpu.runtime.watchdog import HANG_EXIT_CODE
+
+    code = (
+        "import os, sys\n"
+        "sys.exit(%d if os.environ['DLCFN_ATTEMPT'] == '0' else 0)\n"
+        % HANG_EXIT_CODE
+    )
+    launcher = JobLauncher(transport=LocalTransport(), max_restarts=2,
+                           tail_rank0=False)
+    result = launcher.run(_spec(1), _py(code), str(tmp_path / "logs"))
+    assert result.success
+    assert result.restarts == 1
+    assert result.attempt_outcomes == ["hang", "ok"]
 
 
 # -- SshTransport through a fake-ssh PATH shim ------------------------------

@@ -70,9 +70,33 @@ def test_queue_overload_is_explicit():
     with pytest.raises(OverloadError) as ei:
         q.submit([5, 2], 4)
     assert ei.value.depth == 2 and ei.value.max_depth == 2
+    # No admissions yet → no wait history → no hint, bare message.
+    assert ei.value.retry_after_s is None
     # Draining makes room again — bounded, not closed.
     q.pop_ready()
     q.submit([5, 2], 4)
+
+
+def test_overload_carries_retry_after_hint_from_queue_waits():
+    """Once the queue has admission history, a rejection tells the caller
+    HOW LONG to back off: the p50 of recent submit→admit waits."""
+    t = {"now": 0.0}
+    q = RequestQueue(max_depth=1, clock=lambda: t["now"])
+    q.submit([5, 2], 4)
+    t["now"] = 2.0  # the request waited 2s before admission
+    assert q.pop_ready() is not None
+    q.submit([5, 2], 4)
+    with pytest.raises(OverloadError) as ei:
+        q.submit([5, 2], 4)
+    assert ei.value.retry_after_s == 2.0
+    assert "~2.000s" in str(ei.value)
+    # The engine-side metrics record the hint on reject.
+    m = ServeMetrics(capacity=4)
+    m.record_reject(ei.value.retry_after_s)
+    snap = m.snapshot()
+    assert snap["serve_retry_after_hint_s"] == 2.0
+    assert snap["serve_rejected"] == 1
+    assert "serve_ckpt_load_retries" in snap
 
 
 def test_queue_rejects_bad_requests():
