@@ -421,6 +421,113 @@ def run_bench(
     return record
 
 
+def run_obs_overhead_smoke(
+    preset: str = "transformer_nmt_wmt",
+    steps: int = 30,
+    warmup: int = 5,
+    global_batch: int = 0,
+    mesh=None,
+) -> Dict:
+    """Measure the obs span tracer's per-step cost: the SAME compiled step,
+    once with spans disabled (``DLCFN_OBS_OFF``-equivalent) and once fully
+    instrumented (span + sink write per step — the train loop's worst
+    case). The acceptance bar is <= 5% step-time delta on the CPU
+    transformer_nmt config; the record reports ``overhead_pct`` so the
+    driver can gate on it."""
+    stage("import_jax")
+    import jax
+
+    from .runtime.platform import honor_env_platform
+
+    honor_env_platform()
+    import numpy as np
+
+    from .config import MeshConfig, apply_overrides
+    from .data import build_pipeline
+    from .obs.sinks import MemorySink
+    from .obs.trace import Tracer, configured, set_enabled, span
+    from .parallel.mesh import build_mesh, local_batch_size
+    from .presets import get_preset
+    from .train import create_train_state
+    from .train.optim import build_optimizer, build_schedule
+    from .train.task import build_task
+    from .train.trainer import Trainer
+
+    cfg = get_preset(preset)
+    cfg.train.global_batch = global_batch or (
+        64 if jax.device_count() == 1 else cfg.train.global_batch)
+    cfg.train.grad_accum_steps = 1
+    apply_overrides(cfg, ["data.prefetch=0", "data.synthetic=true"])
+    cfg.data.num_train_examples = cfg.train.global_batch
+    cfg.data.num_eval_examples = cfg.train.global_batch
+    mesh = mesh if mesh is not None else build_mesh(MeshConfig(data=-1))
+    gb = cfg.train.global_batch
+
+    task = build_task(cfg, mesh=mesh)
+    tx = build_optimizer(cfg.optimizer,
+                         build_schedule(cfg.schedule, 1000, gb, 100))
+    state = create_train_state(jax.random.PRNGKey(0), task.init, tx, mesh,
+                               param_rules=getattr(task, "param_rules", ()),
+                               shard_opt_state=cfg.train.shard_opt_state)
+    trainer = Trainer(cfg, task.loss_fn, tx, mesh=mesh,
+                      spatial_dim=getattr(task, "spatial_dim", None),
+                      spatial_keys=getattr(task, "spatial_keys", None))
+    pipe = build_pipeline(cfg.data, local_batch_size(gb, mesh),
+                          cfg.model.num_classes, seed=0, train=True)
+    dev_batch = trainer.device_batch(next(iter(pipe.one_epoch(0))))
+    rng = jax.random.PRNGKey(1)
+    stage("first_compile")
+    compiled = trainer.train_step.lower(state, dev_batch, rng).compile()
+
+    # The compiled step donates the state buffers, so each loop must hand
+    # its final state to the next one — re-entering with the original
+    # `state` would pass already-donated buffers.
+    def timed_loop(st, enabled: bool):
+        set_enabled(enabled)
+        try:
+            for _ in range(max(warmup, 1)):
+                st, m = compiled(st, dev_batch, rng)
+            float(np.asarray(m["loss"]).reshape(-1)[-1])
+            t0 = time.perf_counter()
+            for i in range(steps):
+                with span("train.dispatch", step=i, k=1):
+                    st, m = compiled(st, dev_batch, rng)
+            float(np.asarray(m["loss"]).reshape(-1)[-1])
+            return st, (time.perf_counter() - t0) / steps
+        finally:
+            set_enabled(None)
+
+    # A dedicated tracer with a live sink so the "on" loop pays the FULL
+    # instrumented cost (id alloc, record build, sink write) — then the
+    # process default is restored.
+    tracer = Tracer()
+    tracer.add_sink(MemorySink())
+    configured(tracer)
+    try:
+        stage("timed_obs_off", steps=steps)
+        state, off_s = timed_loop(state, False)
+        stage("timed_obs_on", steps=steps)
+        state, on_s = timed_loop(state, True)
+    finally:
+        configured(None)
+
+    overhead_pct = (on_s - off_s) / off_s * 100.0
+    record = {
+        "metric": f"{preset}_obs_overhead_pct",
+        "value": round(overhead_pct, 3),
+        "unit": "percent",
+        "obs_off_step_s": round(off_s, 6),
+        "obs_on_step_s": round(on_s, 6),
+        "steps": steps,
+        "global_batch": gb,
+        "preset": preset,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "measured": True,
+    }
+    stage("done", overhead_pct=record["value"])
+    return record
+
+
 def main(argv=None) -> None:
     """Child-process entry for the driver bench (see root ``bench.py``):
     run one preset and print the contract JSON line."""
@@ -438,12 +545,21 @@ def main(argv=None) -> None:
     parser.add_argument("--step-window", type=int, default=1,
                         help="fuse K steps per dispatch (bench the "
                              "train-loop fast path's scan program)")
+    parser.add_argument("--obs-smoke", action="store_true",
+                        help="measure obs span overhead (instrumented vs "
+                             "disabled step time) instead of throughput")
     args = parser.parse_args(argv)
     stage("start", preset=args.preset)
-    record = run_bench(preset=args.preset, steps=args.steps,
-                       warmup=args.warmup, global_batch=args.global_batch,
-                       include_input=args.with_input,
-                       step_window=args.step_window)
+    if args.obs_smoke:
+        record = run_obs_overhead_smoke(
+            preset=args.preset, steps=args.steps, warmup=args.warmup,
+            global_batch=args.global_batch)
+    else:
+        record = run_bench(preset=args.preset, steps=args.steps,
+                           warmup=args.warmup,
+                           global_batch=args.global_batch,
+                           include_input=args.with_input,
+                           step_window=args.step_window)
     print(json.dumps(record), flush=True)
 
 

@@ -40,6 +40,7 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 import jax
 import numpy as np
 
+from ..obs.trace import span
 from ..utils.trees import flatten_with_names
 from .store import RetryPolicy, Store, open_store
 
@@ -128,33 +129,44 @@ def save_checkpoint(
         proc_manifest["leaves"][name] = entries
 
     def write_files():
-        # 1. This process's shard object + manifest (atomic puts).
-        store.put_npz(f"{key}/shards_p{pidx}.npz", arrays)
-        store.put_bytes(f"{key}/manifest_p{pidx}.json",
-                        json.dumps(proc_manifest).encode())
-        if pidx == 0:
-            store.put_bytes(f"{key}/{_MANIFEST}",
-                            json.dumps(tree_manifest).encode())
-        # 2. Marker, then storage-level commit rendezvous. No device
-        # collective here: a barrier on this thread could interleave with
-        # training collectives on the main thread and deadlock the pod.
-        store.put_bytes(f"{key}/DONE_p{pidx}", str(step).encode())
-        if pidx == 0:
-            deadline = time.time() + _DONE_TIMEOUT_S
-            sleep_s = 0.05  # backoff: a list() is an API call on GCS
-            while len([k for k in store.list(f"{key}/")
-                       if k.rsplit("/", 1)[-1].startswith("DONE_p")]) \
-                    < pcount:
-                if time.time() > deadline:  # pragma: no cover
-                    print(f"[dlcfn-tpu] WARNING: checkpoint step {step} not "
-                          f"committed: missing DONE markers after "
-                          f"{_DONE_TIMEOUT_S}s")
-                    return
-                time.sleep(sleep_s)
-                sleep_s = min(sleep_s * 1.6, 2.0)
-            store.put_bytes(f"{key}/{_COMMIT}", str(step).encode())
-            if keep > 0:
-                _garbage_collect(store, keep)
+        # The span runs on whichever thread writes (the async thread in
+        # async mode — its own parent stack, so it never links under an
+        # unrelated main-thread span); retries absorbed by the store are
+        # annotated at close so `obs summarize` can pair latency spikes
+        # with retry storms.
+        retries_before = int(getattr(store, "retries_total", 0))
+        with span("ckpt.save", step=step, async_write=async_write) as sp:
+            # 1. This process's shard object + manifest (atomic puts).
+            store.put_npz(f"{key}/shards_p{pidx}.npz", arrays)
+            store.put_bytes(f"{key}/manifest_p{pidx}.json",
+                            json.dumps(proc_manifest).encode())
+            if pidx == 0:
+                store.put_bytes(f"{key}/{_MANIFEST}",
+                                json.dumps(tree_manifest).encode())
+            # 2. Marker, then storage-level commit rendezvous. No device
+            # collective here: a barrier on this thread could interleave
+            # with training collectives on the main thread and deadlock
+            # the pod.
+            store.put_bytes(f"{key}/DONE_p{pidx}", str(step).encode())
+            if pidx == 0:
+                deadline = time.time() + _DONE_TIMEOUT_S
+                sleep_s = 0.05  # backoff: a list() is an API call on GCS
+                while len([k for k in store.list(f"{key}/")
+                           if k.rsplit("/", 1)[-1].startswith("DONE_p")]) \
+                        < pcount:
+                    if time.time() > deadline:  # pragma: no cover
+                        print(f"[dlcfn-tpu] WARNING: checkpoint step "
+                              f"{step} not committed: missing DONE "
+                              f"markers after {_DONE_TIMEOUT_S}s")
+                        sp.annotate(committed=False)
+                        return
+                    time.sleep(sleep_s)
+                    sleep_s = min(sleep_s * 1.6, 2.0)
+                store.put_bytes(f"{key}/{_COMMIT}", str(step).encode())
+                if keep > 0:
+                    _garbage_collect(store, keep)
+            sp.annotate(retries=int(getattr(store, "retries_total", 0))
+                        - retries_before)
 
     if async_write:
         t = threading.Thread(target=write_files, daemon=True)
@@ -289,6 +301,20 @@ def restore_checkpoint(
         if step is None:
             raise FileNotFoundError(f"no committed checkpoint in "
                                     f"{store.describe()}")
+    retries_before = int(getattr(store, "retries_total", 0))
+    with span("ckpt.restore", step=step) as sp:
+        out = _restore_resolved(store, target, step, shardings)
+        sp.annotate(retries=int(getattr(store, "retries_total", 0))
+                    - retries_before)
+    return out
+
+
+def _restore_resolved(
+    store: Store,
+    target: PyTree,
+    step: int,
+    shardings: Optional[PyTree],
+) -> Tuple[PyTree, int]:
     key = _step_key(step)
     manifest = json.loads(store.get_bytes(f"{key}/{_MANIFEST}"))
 

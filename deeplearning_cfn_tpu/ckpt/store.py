@@ -32,6 +32,8 @@ from typing import Callable, Dict, List, Optional, Union
 
 import numpy as np
 
+from ..obs.trace import span as _span
+
 
 class Store:
     """Atomic whole-object storage. All implementations must guarantee a
@@ -444,7 +446,12 @@ class RetryingStore(Store):
                     self.retries_total += 1
                     self.retries_by_op[op] = \
                         self.retries_by_op.get(op, 0) + 1
-                self._sleep(delay)
+                # The span brackets the observable retry event (the
+                # backoff sleep before the re-attempt) so a run report
+                # shows retry counts and where the backoff time went.
+                with _span("ckpt.store_retry", op=op, attempt=attempt,
+                           delay_s=round(delay, 4)):
+                    self._sleep(delay)
 
     def put_bytes(self, key, data):
         return self._call("put_bytes",

@@ -339,6 +339,14 @@ def _cmd_bench(args) -> int:
         print("[dlcfn-tpu] --smoke is a serving-scenario mode — pass it "
               "with --serve", file=sys.stderr)
         return 2
+    if getattr(args, "obs_smoke", False):
+        from ..bench import run_obs_overhead_smoke
+
+        record = run_obs_overhead_smoke(
+            preset=args.preset, steps=args.steps,
+            global_batch=args.global_batch)
+        print(json.dumps(record))
+        return 0
     if getattr(args, "serve", False):
         if getattr(args, "ops", None) or args.collectives or \
                 getattr(args, "sweep_batches", None):
@@ -669,6 +677,28 @@ def _cmd_metrics(args) -> int:
         out["final"] = {k: v for k, v in finals[-1].items()
                         if k.startswith("final_eval_")}
     print(json.dumps(out))
+    return 0
+
+
+def _cmd_obs_summarize(args) -> int:
+    """Full run report (train + serve + spans + launch attempts) from a
+    metrics.jsonl or a run directory — the obs subsystem's reporting verb.
+    ``dlcfn-tpu metrics`` stays the quick one-line JSON summary; this one
+    answers "what happened in this run"."""
+    from ..obs.report import render_report, summarize
+
+    path = args.path
+    if not os.path.exists(path):
+        print(f"[dlcfn-tpu] ERROR: no metrics file or directory at {path}",
+              file=sys.stderr)
+        return 1
+    summary = summarize(path)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(render_report(summary))
+    if summary["source"]["records"] == 0:
+        return 1
     return 0
 
 
@@ -1041,6 +1071,10 @@ def build_parser() -> argparse.ArgumentParser:
     be.add_argument("--smoke", action="store_true",
                     help="serving scenario: CI fast mode (few requests, "
                          "tiny budget, same record contract)")
+    be.add_argument("--obs-smoke", action="store_true",
+                    help="obs overhead smoke: step time instrumented vs "
+                         "spans disabled (the <=5%% gate; use "
+                         "--preset transformer_nmt_wmt on CPU)")
     be.set_defaults(fn=_cmd_bench)
 
     met = sub.add_parser(
@@ -1049,6 +1083,22 @@ def build_parser() -> argparse.ArgumentParser:
              "mean throughput)")
     met.add_argument("path", help="metrics.jsonl path (or its directory)")
     met.set_defaults(fn=_cmd_metrics)
+
+    # obs --------------------------------------------------------------------
+    ob = sub.add_parser(
+        "obs",
+        help="observability: run reports over metrics/span JSONL streams")
+    obsub = ob.add_subparsers(dest="obs_command", required=True)
+    obsum = obsub.add_parser(
+        "summarize",
+        help="render a run report (step-time p50/p95, tokens/sec, ckpt "
+             "latency + retries, queue wait, per-attempt outcomes) from a "
+             "metrics.jsonl file or a run directory of *.jsonl streams")
+    obsum.add_argument("path", help="metrics.jsonl path or run directory")
+    obsum.add_argument("--json", action="store_true",
+                       help="emit the summary as one JSON object instead "
+                            "of the text report")
+    obsum.set_defaults(fn=_cmd_obs_summarize)
 
     # ckpt -------------------------------------------------------------------
     ck = sub.add_parser("ckpt", help="checkpoint inspection / rollback")

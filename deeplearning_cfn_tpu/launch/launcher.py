@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Callable, Dict, IO, List, Optional, Sequence
 
+from ..metrics.jsonl import MetricsWriter
+from ..obs.trace import span
 from ..runtime.cluster import ClusterSpec, cluster_env
 from ..runtime.watchdog import HANG_EXIT_CODE
 
@@ -235,20 +237,34 @@ class JobLauncher:
         extra_env = extra_env or {}
         attempt = 0
         outcomes: List[str] = []
-        while True:
-            codes = self._run_attempt(spec, argv, log_dir, attempt,
-                                      extra_env, cwd, on_failure)
-            outcome = classify_attempt(codes)
-            outcomes.append(outcome)
-            if outcome == "ok":
-                return JobResult(True, attempt, codes, log_dir,
-                                 attempt_outcomes=outcomes)
-            print(f"[dlcfn-tpu] attempt {attempt} failed ({outcome}): "
-                  f"exit codes {codes}"
-                  + (" — watchdog hang exit, wedged collective suspected"
-                     if outcome == "hang" else ""))
-            if attempt >= self.max_restarts:
-                return JobResult(False, attempt, codes, log_dir,
-                                 attempt_outcomes=outcomes)
-            attempt += 1
-            time.sleep(min(2.0 ** attempt, 10.0))  # backoff before retry
+        # Attempt lifecycle events land in log_dir/launch.jsonl (the obs
+        # report's per-attempt-outcomes section reads them). all_processes:
+        # the launcher is host-side orchestration — no jax, no rank.
+        events = MetricsWriter(os.path.join(log_dir, "launch.jsonl"),
+                               also_stdout=False, all_processes=True)
+        try:
+            while True:
+                with span("launch.attempt", attempt=attempt,
+                          hosts=len(spec.hosts)) as sp:
+                    codes = self._run_attempt(spec, argv, log_dir, attempt,
+                                              extra_env, cwd, on_failure)
+                    outcome = classify_attempt(codes)
+                    sp.annotate(outcome=outcome)
+                outcomes.append(outcome)
+                events.write({"event": "launch_attempt", "attempt": attempt,
+                              "outcome": outcome, "exit_codes": codes,
+                              "success": outcome == "ok"})
+                if outcome == "ok":
+                    return JobResult(True, attempt, codes, log_dir,
+                                     attempt_outcomes=outcomes)
+                print(f"[dlcfn-tpu] attempt {attempt} failed ({outcome}): "
+                      f"exit codes {codes}"
+                      + (" — watchdog hang exit, wedged collective suspected"
+                         if outcome == "hang" else ""))
+                if attempt >= self.max_restarts:
+                    return JobResult(False, attempt, codes, log_dir,
+                                     attempt_outcomes=outcomes)
+                attempt += 1
+                time.sleep(min(2.0 ** attempt, 10.0))  # backoff before retry
+        finally:
+            events.close()

@@ -14,30 +14,51 @@ import sys
 import time
 from typing import Any, Dict, IO, List, Optional
 
-import jax
-
 
 class MetricsWriter:
     """Append-only JSONL writer; no-op on non-zero processes by default so
     multi-host runs produce one metrics stream (the reference's "rank 0
-    prints" convention)."""
+    prints" convention).
+
+    Construction is side-effect free: the process index (which forces JAX
+    backend init — on a wedged TPU runtime that init can hang, and a bench
+    probe constructing a writer must not) and the file handle are both
+    resolved lazily on the first :meth:`write`.
+    """
 
     def __init__(self, path: Optional[str], also_stdout: bool = True,
                  all_processes: bool = False):
-        self.enabled = all_processes or jax.process_index() == 0
+        self._path = path
         self.also_stdout = also_stdout
+        self._all_processes = all_processes
+        self._enabled: Optional[bool] = True if all_processes else None
         self._fh: Optional[IO[str]] = None
-        if self.enabled and path:
-            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-            self._fh = open(path, "a", buffering=1)
+        self._opened = False
+
+    @property
+    def enabled(self) -> bool:
+        if self._enabled is None:
+            import jax  # deferred: forces backend init
+            self._enabled = jax.process_index() == 0
+        return self._enabled
+
+    def _file(self) -> Optional[IO[str]]:
+        if not self._opened:
+            self._opened = True
+            if self._path:
+                os.makedirs(os.path.dirname(os.path.abspath(self._path)),
+                            exist_ok=True)
+                self._fh = open(self._path, "a", buffering=1)
+        return self._fh
 
     def write(self, record: Dict[str, Any]) -> None:
         if not self.enabled:
             return
         record = {"ts": time.time(), **record}
         line = json.dumps(record, default=float)
-        if self._fh is not None:
-            self._fh.write(line + "\n")
+        fh = self._file()
+        if fh is not None:
+            fh.write(line + "\n")
         if self.also_stdout:
             print(line, file=sys.stdout, flush=True)
 

@@ -1,0 +1,70 @@
+"""Unified observability subsystem (SURVEY.md §6, grown up).
+
+The reference stack's observability was "stdout prints + CloudWatch agent";
+the rebuild had grown three disjoint substitutes (train JSONL, serve's
+ad-hoc dict, profiling timers) with no shared naming and no spans. ``obs``
+is the one telemetry layer under all of them:
+
+- :mod:`obs.metrics` — a :class:`MetricsRegistry` of typed instruments
+  (:class:`Counter`, :class:`Gauge`, :class:`Histogram` with fixed
+  exponential buckets), per-instrument labels, and the shared
+  :func:`percentile` math every p50/p95 in the repo goes through.
+- :mod:`obs.trace` — a low-overhead span tracer: ``with span("ckpt.save",
+  step=N):`` produces deterministic monotonic-clock span records with
+  parent/child nesting (ids from a counter, never wall-clock-randomized).
+  ``DLCFN_OBS_OFF=1`` turns every span into a no-op.
+- :mod:`obs.sinks` — pluggable exporters: the existing JSONL event stream
+  (byte-compatible for old keys — span records are purely additive), a
+  Prometheus text-format snapshot file, and an in-memory sink for tests.
+- :mod:`obs.report` — ``dlcfn-tpu obs summarize <metrics.jsonl|dir>``:
+  a run report (step-time p50/p95, tokens/sec, checkpoint latency +
+  retries, queue wait, per-attempt launch outcomes) for train and serve
+  runs alike.
+
+See docs/OBSERVABILITY.md for instrument/span naming conventions.
+"""
+
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    exponential_buckets,
+    percentile,
+)
+from .report import render_report, summarize  # noqa: F401
+from .sinks import (  # noqa: F401
+    JsonlSink,
+    MemorySink,
+    render_prometheus,
+    write_prometheus,
+)
+from .trace import (  # noqa: F401
+    Tracer,
+    configured,
+    get_tracer,
+    obs_enabled,
+    set_enabled,
+    span,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "exponential_buckets",
+    "percentile",
+    "JsonlSink",
+    "MemorySink",
+    "render_prometheus",
+    "write_prometheus",
+    "render_report",
+    "summarize",
+    "Tracer",
+    "configured",
+    "get_tracer",
+    "obs_enabled",
+    "set_enabled",
+    "span",
+]

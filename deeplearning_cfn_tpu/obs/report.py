@@ -1,0 +1,272 @@
+"""Run reports: turn a metrics.jsonl (or a run directory) into answers.
+
+``dlcfn-tpu obs summarize <metrics.jsonl|dir>`` is the "what happened in
+this run" verb the JSONL stream never had — before this, the answer was
+hand-grepping. The summarizer is intentionally forgiving: it takes any
+mix of train records, serve snapshots, span records, and launcher attempt
+events in one stream (or across ``*.jsonl`` files in a directory),
+skips torn/partial lines (a crash mid-write must not kill the post-mortem
+tool), and reports only the sections it has data for.
+
+Sections:
+
+- **train** — steps reached, step-time p50/p95 (from the additive
+  ``step_time_s`` boundary key), examples/sec (last + peak), compile
+  time, eval/final-eval metrics, checkpoint store retries.
+- **serve** — from the last ``serve_*`` snapshot: tokens/sec, queue
+  wait / TTFT / latency / step-latency percentiles, admission counters.
+- **spans** — per-name count and duration p50/p95 from span records
+  (ckpt.save latency lives here).
+- **launch** — per-attempt outcomes (``ok``/``hang``/``crash``) from
+  launcher attempt events, mirroring ``JobResult.attempt_outcomes``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from .metrics import percentile
+
+
+def _iter_records(path: str) -> Tuple[List[Dict[str, Any]], int]:
+    """Lenient JSONL parse: (records, skipped_line_count)."""
+    records, skipped = [], 0
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+            else:
+                skipped += 1
+    return records, skipped
+
+
+def collect(path: str) -> Tuple[List[Dict[str, Any]], List[str], int]:
+    """Load records from a file, or every ``*.jsonl`` under a directory
+    (one level, plus ``logs/``). Returns (records, files, skipped)."""
+    if os.path.isdir(path):
+        files = []
+        for sub in ("", "logs"):
+            d = os.path.join(path, sub) if sub else path
+            if os.path.isdir(d):
+                files.extend(
+                    os.path.join(d, f) for f in sorted(os.listdir(d))
+                    if f.endswith(".jsonl"))
+        records, skipped = [], 0
+        for f in files:
+            rs, sk = _iter_records(f)
+            records.extend(rs)
+            skipped += sk
+        return records, files, skipped
+    records, skipped = _iter_records(path)
+    return records, [path], skipped
+
+
+def _pct_pair(xs: List[float]) -> Dict[str, Optional[float]]:
+    return {"p50": percentile(xs, 50), "p95": percentile(xs, 95)}
+
+
+def summarize(path: str) -> Dict[str, Any]:
+    """Build the run-report dict. Always includes ``source``; train /
+    serve / spans / launch sections appear only when present."""
+    records, files, skipped = collect(path)
+    out: Dict[str, Any] = {
+        "source": {"path": path, "files": len(files),
+                   "records": len(records), "skipped_lines": skipped},
+    }
+
+    train = [r for r in records if "step" in r and "span" not in r
+             and not any(k.startswith("serve_") for k in r)]
+    serve = [r for r in records
+             if any(k.startswith("serve_") for k in r)]
+    spans = [r for r in records if "span" in r]
+    launch = [r for r in records if r.get("event") == "launch_attempt"]
+
+    if train:
+        steps = [r["step"] for r in train
+                 if isinstance(r.get("step"), (int, float))]
+        step_times = [r["step_time_s"] for r in train
+                      if isinstance(r.get("step_time_s"), (int, float))]
+        eps = [r["examples_per_sec"] for r in train
+               if isinstance(r.get("examples_per_sec"), (int, float))]
+        losses = [r["loss"] for r in train
+                  if isinstance(r.get("loss"), (int, float))]
+        compile_s = next(
+            (r["compile_s"] for r in train
+             if isinstance(r.get("compile_s"), (int, float))), None)
+        retries = [r["ckpt_store_retries"] for r in train
+                   if isinstance(r.get("ckpt_store_retries"), (int, float))]
+        evals = {}
+        for r in train:
+            for k, v in r.items():
+                if k.startswith(("eval_", "final_eval_")):
+                    evals[k] = v
+        out["train"] = {
+            "last_step": max(steps) if steps else None,
+            "records": len(train),
+            "step_time_s": _pct_pair(step_times),
+            "examples_per_sec": {
+                "last": eps[-1] if eps else None,
+                "peak": max(eps) if eps else None,
+            },
+            "loss": {
+                "first": losses[0] if losses else None,
+                "last": losses[-1] if losses else None,
+            },
+            "compile_s": compile_s,
+            "ckpt_store_retries": retries[-1] if retries else None,
+            "eval": evals or None,
+        }
+
+    if serve:
+        last = serve[-1]
+        out["serve"] = {
+            "records": len(serve),
+            "submitted": last.get("serve_submitted"),
+            "admitted": last.get("serve_admitted"),
+            "completed": last.get("serve_completed"),
+            "rejected": last.get("serve_rejected"),
+            "cancelled": last.get("serve_cancelled"),
+            "expired": last.get("serve_expired"),
+            "tokens_generated": last.get("serve_tokens_generated"),
+            "tokens_per_sec": last.get("serve_tokens_per_sec"),
+            "slot_occupancy": last.get("serve_slot_occupancy"),
+            "steps_per_window": last.get("serve_steps_per_window"),
+            "ckpt_load_retries": last.get("serve_ckpt_load_retries"),
+            "queue_wait_s": {
+                "p50": last.get("serve_queue_wait_p50_s"),
+                "p95": last.get("serve_queue_wait_p95_s"),
+            },
+            "ttft_s": {
+                "p50": last.get("serve_ttft_p50_s"),
+                "p95": last.get("serve_ttft_p95_s"),
+            },
+            "latency_s": {
+                "p50": last.get("serve_latency_p50_s"),
+                "p95": last.get("serve_latency_p95_s"),
+            },
+            "step_latency_s": {
+                "p50": last.get("serve_step_latency_p50_s"),
+                "p95": last.get("serve_step_latency_p95_s"),
+            },
+        }
+
+    if spans:
+        by_name: Dict[str, List[float]] = {}
+        fails: Dict[str, int] = {}
+        for r in spans:
+            name = r["span"]
+            if isinstance(r.get("dur_s"), (int, float)):
+                by_name.setdefault(name, []).append(r["dur_s"])
+            if r.get("ok") is False:
+                fails[name] = fails.get(name, 0) + 1
+        out["spans"] = {
+            name: {"count": len(durs), **_pct_pair(durs),
+                   **({"failed": fails[name]} if name in fails else {})}
+            for name, durs in sorted(by_name.items())
+        }
+
+    if launch:
+        outcomes = [r.get("outcome") for r in launch]
+        out["launch"] = {
+            "attempts": len(launch),
+            "outcomes": outcomes,
+            "success": bool(launch[-1].get("success",
+                                           outcomes[-1] == "ok")),
+            "restarts": max(0, len(launch) - 1),
+        }
+
+    return out
+
+
+def _fmt(v: Any, unit: str = "") -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return "yes" if v else "no"
+    if isinstance(v, float):
+        if v != 0 and abs(v) < 0.001:
+            return f"{v:.2e}{unit}"
+        return f"{v:.4g}{unit}"
+    return f"{v}{unit}"
+
+
+def render_report(summary: Dict[str, Any]) -> str:
+    """Human-readable text rendering of :func:`summarize` output."""
+    L: List[str] = []
+    src = summary["source"]
+    L.append(f"run report: {src['path']}")
+    L.append(f"  files={src['files']} records={src['records']}"
+             + (f" skipped_lines={src['skipped_lines']}"
+                if src["skipped_lines"] else ""))
+
+    t = summary.get("train")
+    if t:
+        L.append("train:")
+        L.append(f"  last step           {_fmt(t['last_step'])}")
+        st = t["step_time_s"]
+        L.append(f"  step time p50/p95   {_fmt(st['p50'], 's')} / "
+                 f"{_fmt(st['p95'], 's')}")
+        e = t["examples_per_sec"]
+        L.append(f"  examples/sec        last {_fmt(e['last'])}  "
+                 f"peak {_fmt(e['peak'])}")
+        lo = t["loss"]
+        L.append(f"  loss                {_fmt(lo['first'])} -> "
+                 f"{_fmt(lo['last'])}")
+        L.append(f"  compile             {_fmt(t['compile_s'], 's')}")
+        L.append(f"  ckpt store retries  {_fmt(t['ckpt_store_retries'])}")
+        if t["eval"]:
+            for k, v in sorted(t["eval"].items()):
+                L.append(f"  {k:<19} {_fmt(v)}")
+
+    s = summary.get("serve")
+    if s:
+        L.append("serve:")
+        L.append(f"  submitted/admitted/completed  "
+                 f"{_fmt(s['submitted'])}/{_fmt(s['admitted'])}/"
+                 f"{_fmt(s['completed'])}")
+        L.append(f"  rejected/cancelled/expired    "
+                 f"{_fmt(s['rejected'])}/{_fmt(s['cancelled'])}/"
+                 f"{_fmt(s['expired'])}")
+        L.append(f"  tokens/sec          {_fmt(s['tokens_per_sec'])}  "
+                 f"(total {_fmt(s['tokens_generated'])})")
+        L.append(f"  slot occupancy      {_fmt(s['slot_occupancy'])}")
+        L.append(f"  steps/window        {_fmt(s['steps_per_window'])}")
+        L.append(f"  ckpt load retries   {_fmt(s['ckpt_load_retries'])}")
+        for key, label in (("queue_wait_s", "queue wait"),
+                           ("ttft_s", "ttft"),
+                           ("latency_s", "latency"),
+                           ("step_latency_s", "step latency")):
+            p = s[key]
+            L.append(f"  {label:<19} p50 {_fmt(p['p50'], 's')}  "
+                     f"p95 {_fmt(p['p95'], 's')}")
+
+    sp = summary.get("spans")
+    if sp:
+        L.append("spans:")
+        for name, v in sp.items():
+            extra = f"  failed {v['failed']}" if "failed" in v else ""
+            L.append(f"  {name:<19} n={v['count']:<5} "
+                     f"p50 {_fmt(v['p50'], 's')}  "
+                     f"p95 {_fmt(v['p95'], 's')}{extra}")
+
+    la = summary.get("launch")
+    if la:
+        L.append("launch:")
+        L.append(f"  attempts            {la['attempts']} "
+                 f"({', '.join(str(o) for o in la['outcomes'])})")
+        L.append(f"  success             {_fmt(la['success'])}  "
+                 f"restarts {la['restarts']}")
+
+    if len(L) == 2:
+        L.append("(no train, serve, span, or launch records found)")
+    return "\n".join(L)

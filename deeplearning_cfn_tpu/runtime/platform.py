@@ -20,6 +20,23 @@ import re
 from typing import Optional
 
 
+def enable_partitionable_rng() -> None:
+    """Make random bit generation mesh-layout-invariant.
+
+    jax 0.4.37 defaults ``jax_threefry_partitionable=False``, under which
+    the bits behind ``jax.random`` ops traced with sharded operands depend
+    on the mesh layout — dropout masks (and so whole training
+    trajectories) differ between e.g. ``data=8`` and ``data=4, model=2``,
+    which is exactly what the TP/MoE/pipeline/3-axis parity tests caught.
+    Newer jax defaults this to True. Forcing True keeps every layout on
+    the same trajectory and is also the efficient lowering on real
+    hardware (shard-local generation, no global iota materialization).
+    """
+    import jax
+
+    jax.config.update("jax_threefry_partitionable", True)
+
+
 def force_cpu_platform(n_devices: Optional[int] = None) -> None:
     """Force the CPU backend, optionally with ``n_devices`` virtual devices.
 
@@ -42,6 +59,7 @@ def force_cpu_platform(n_devices: Optional[int] = None) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    enable_partitionable_rng()
 
 
 def honor_env_platform() -> None:
@@ -53,3 +71,7 @@ def honor_env_platform() -> None:
         import jax
 
         jax.config.update("jax_platforms", platforms)
+    # Every entry point routes through this helper or force_cpu_platform;
+    # both pin layout-invariant RNG so train trajectories match across
+    # mesh layouts everywhere, not just under the test harness.
+    enable_partitionable_rng()

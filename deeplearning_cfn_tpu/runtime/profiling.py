@@ -12,9 +12,11 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, Optional
 
 import jax
+
+from ..obs.metrics import Histogram, MetricsRegistry, percentile
 
 DEFAULT_PROFILER_PORT = 9012
 
@@ -33,12 +35,25 @@ def start_profiler_server(port: int = DEFAULT_PROFILER_PORT) -> Optional[int]:
 @contextlib.contextmanager
 def trace_steps(log_dir: str) -> Iterator[None]:
     """Capture a device+host trace of the enclosed steps to ``log_dir``
-    (TensorBoard 'profile' plugin format)."""
+    (TensorBoard 'profile' plugin format).
+
+    ``stop_trace`` runs only if ``start_trace`` succeeded, and any error
+    it raises is swallowed when the body already raised — the body's
+    exception is the one the operator needs, and a secondary "no trace
+    in progress" must never mask it."""
     jax.profiler.start_trace(log_dir)
+    body_failed = False
     try:
         yield
+    except BaseException:
+        body_failed = True
+        raise
     finally:
-        jax.profiler.stop_trace()
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            if not body_failed:
+                raise
 
 
 class StepTimer:
@@ -47,11 +62,19 @@ class StepTimer:
     Async dispatch makes naive timing lie (the Python loop runs ahead of the
     device); this timer syncs on a result before reading the clock, which is
     how every number in BASELINE.md must be measured.
+
+    Timings land in an ``obs`` :class:`Histogram` (``step_time_s``) — raw
+    samples retained, exponential buckets for the Prometheus export — in a
+    per-timer registry by default, or pass ``registry=`` to aggregate into
+    a shared one.
     """
 
-    def __init__(self, warmup: int = 2):
+    def __init__(self, warmup: int = 2,
+                 registry: Optional[MetricsRegistry] = None):
         self.warmup = warmup
-        self._times: List[float] = []
+        self.registry = registry or MetricsRegistry()
+        self._hist: Histogram = self.registry.histogram(
+            "step_time_s", "synced per-step wall time")
         self._count = 0
         self._t0: Optional[float] = None
 
@@ -66,23 +89,25 @@ class StepTimer:
         elapsed = time.perf_counter() - self._t0
         self._count += 1
         if self._count > self.warmup:
-            self._times.append(elapsed)
+            self._hist.observe(elapsed)
         return elapsed
 
     @property
     def steps(self) -> int:
-        return len(self._times)
+        return self._hist.count()
 
     def summary(self, items_per_step: int = 0) -> Dict[str, float]:
-        if not self._times:
+        times = self._hist.samples()
+        if not times:
             return {"steps": 0}
-        total = sum(self._times)
-        mean = total / len(self._times)
+        mean = self._hist.mean()
         out = {
-            "steps": float(len(self._times)),
+            "steps": float(len(times)),
             "mean_step_s": mean,
-            "min_step_s": min(self._times),
-            "max_step_s": max(self._times),
+            "min_step_s": min(times),
+            "max_step_s": max(times),
+            "p50_step_s": percentile(times, 50),
+            "p95_step_s": percentile(times, 95),
         }
         if items_per_step:
             out["items_per_sec"] = items_per_step / mean

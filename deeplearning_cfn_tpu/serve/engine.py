@@ -64,6 +64,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.decoding import BOS_ID, EOS_ID, PAD_ID
+from ..obs.trace import span
 from .metrics import ServeMetrics
 from .queue import OverloadError, Request, RequestQueue, RequestState
 
@@ -431,12 +432,16 @@ class Engine:
         single-step logits path so beam parity is untouched."""
         now = self._clock()
         self._reap(now)
-        self._admit(now)
+        with span("serve.admit", queued=self.queue.depth):
+            self._admit(now)
         if not self._groups:
             return 0
         if any(g.req.beam_size > 1 for g in self._groups):
-            return self._host_step()
-        return self._fused_step(self._plan_window())
+            with span("serve.decode", path="host", k=1):
+                return self._host_step()
+        k = self._plan_window()
+        with span("serve.decode", path="fused", k=k):
+            return self._fused_step(k)
 
     def _fused_step(self, k: int) -> int:
         """Greedy fast path: K fused steps in one device call."""

@@ -16,6 +16,14 @@ Step accounting is window-aware: one :meth:`record_step` call covers one
 device call, which since the device-resident fast path may span several
 fused decode steps (``steps``). ``serve_steps`` counts decode steps,
 ``serve_decode_windows`` counts device calls.
+
+Storage lives in an :class:`obs.MetricsRegistry` (typed Counter/Gauge/
+Histogram instruments — one ``serve_requests_total{state=...}`` counter
+family instead of six loose ints, distribution histograms that retain raw
+samples). The public surface is unchanged: every pre-registry attribute
+(``submitted``, ``ttft_s`` as a list, settable ``ckpt_load_retries``, ...)
+is a property over the instruments, and :meth:`snapshot` emits the exact
+same keys and values — parity-tested in tests/test_obs.py.
 """
 
 from __future__ import annotations
@@ -24,83 +32,74 @@ import time
 from typing import Dict, List, Optional
 
 from ..metrics.jsonl import MetricsWriter
-
-
-def percentile(xs: List[float], q: float) -> Optional[float]:
-    """Nearest-rank-with-interpolation percentile; None on empty input
-    (matching the bench contract's null-over-zero convention)."""
-    if not xs:
-        return None
-    s = sorted(xs)
-    if len(s) == 1:
-        return s[0]
-    rank = (len(s) - 1) * (q / 100.0)
-    lo = int(rank)
-    hi = min(lo + 1, len(s) - 1)
-    frac = rank - lo
-    return s[lo] * (1.0 - frac) + s[hi] * frac
+from ..obs.metrics import MetricsRegistry, percentile  # noqa: F401  (re-export)
 
 
 class ServeMetrics:
     """Accumulates engine-side counters; snapshot() flattens them."""
 
-    def __init__(self, capacity: int, clock=time.monotonic):
+    def __init__(self, capacity: int, clock=time.monotonic,
+                 registry: Optional[MetricsRegistry] = None):
         self.capacity = capacity
         self._clock = clock
         self.started_at = clock()
-        # Lifecycle counters.
-        self.submitted = 0
-        self.rejected = 0
-        self.admitted = 0
-        self.completed = 0
-        self.cancelled = 0
-        self.expired = 0
+        # Per-instance registry by default: two engines in one process
+        # (tests spin several) must not share counters. Pass one in to
+        # export serve metrics alongside a run's other instruments.
+        self.registry = registry or MetricsRegistry()
+        r = self.registry
+        # Lifecycle counters — one family, labelled by terminal state.
+        self._requests = r.counter(
+            "serve_requests_total", "request lifecycle events by state")
         # Step accounting. `steps` counts decode steps; `windows` counts
         # device calls (a fused window is one call spanning many steps).
-        self.steps = 0
-        self.windows = 0
-        self.tokens_generated = 0
-        self.busy_time_s = 0.0
-        self._occupancy_sum = 0.0
-        self.last_queue_depth = 0
+        self._steps = r.counter("serve_steps_total", "decode steps")
+        self._windows = r.counter("serve_windows_total", "device calls")
+        self._tokens = r.counter("serve_tokens_total", "generated tokens")
+        self._busy = r.counter("serve_busy_time_s", "engine-busy seconds")
+        self._occupancy_sum_c = r.counter(
+            "serve_occupancy_sum", "sum of per-step occupancy fractions")
+        self._queue_depth = r.gauge("serve_queue_depth", "admission backlog")
         # Robustness surface: store retries absorbed while loading the
         # checkpoint (set by serve/loader.py), and the most recent
         # retry-after hint handed out with an overload rejection.
-        self.ckpt_load_retries = 0
-        self.last_retry_after_s: Optional[float] = None
-        # Distributions.
-        self.ttft_s: List[float] = []
-        self.latency_s: List[float] = []
-        self.queue_wait_s: List[float] = []
-        self.step_latency_s: List[float] = []
+        self._ckpt_retries = r.gauge(
+            "serve_ckpt_load_retries", "store retries during ckpt load")
+        self._retry_after = r.gauge(
+            "serve_retry_after_hint_s", "last overload retry-after hint")
+        # Distributions (raw samples retained — the p50/p95 contract is
+        # exact percentiles, not bucket interpolation).
+        self._ttft = r.histogram("serve_ttft_s", "submit to first token")
+        self._latency = r.histogram("serve_latency_s", "submit to finish")
+        self._queue_wait = r.histogram("serve_queue_wait_s",
+                                       "submit to admit")
+        self._step_latency = r.histogram("serve_step_latency_s",
+                                         "per decode step device time")
 
     # -- recording hooks (called by the engine) ----------------------------
 
     def record_submit(self) -> None:
-        self.submitted += 1
+        self._requests.inc(state="submitted")
 
     def record_reject(self, retry_after_s: Optional[float] = None) -> None:
-        self.rejected += 1
+        self._requests.inc(state="rejected")
         if retry_after_s is not None:
-            self.last_retry_after_s = retry_after_s
+            self._retry_after.set(retry_after_s)
 
     def record_admit(self, queue_wait_s: Optional[float] = None) -> None:
-        self.admitted += 1
+        self._requests.inc(state="admitted")
         if queue_wait_s is not None:
-            self.queue_wait_s.append(queue_wait_s)
+            self._queue_wait.observe(queue_wait_s)
 
     def record_first_token(self, ttft: float) -> None:
-        self.ttft_s.append(ttft)
+        self._ttft.observe(ttft)
 
     def record_finish(self, state: str, latency: Optional[float]) -> None:
-        if state == "done":
-            self.completed += 1
-        elif state == "cancelled":
-            self.cancelled += 1
-        elif state == "expired":
-            self.expired += 1
+        if state in ("done", "cancelled", "expired"):
+            self._requests.inc(
+                state="completed" if state == "done" else state)
         if latency is not None:
-            self.latency_s.append(latency)
+            self._latency.observe(latency)
 
     def record_step(self, active_rows: float, queue_depth: int,
                     new_tokens: int, step_time_s: float,
@@ -112,33 +111,112 @@ class ServeMetrics:
         stays an average over decode steps whatever the window size.
         """
         steps = max(int(steps), 1)
-        self.steps += steps
-        self.windows += 1
-        self.tokens_generated += new_tokens
-        self.busy_time_s += step_time_s
-        self._occupancy_sum += active_rows / max(self.capacity, 1)
-        self.step_latency_s.append(step_time_s / steps)
-        self.last_queue_depth = queue_depth
+        self._steps.inc(steps)
+        self._windows.inc()
+        self._tokens.inc(new_tokens)
+        self._busy.inc(step_time_s)
+        self._occupancy_sum_c.inc(active_rows / max(self.capacity, 1))
+        self._step_latency.observe(step_time_s / steps)
+        self._queue_depth.set(queue_depth)
+
+    # -- pre-registry attribute surface (properties over instruments) ------
+
+    @property
+    def submitted(self) -> int:
+        return int(self._requests.value(state="submitted"))
+
+    @property
+    def rejected(self) -> int:
+        return int(self._requests.value(state="rejected"))
+
+    @property
+    def admitted(self) -> int:
+        return int(self._requests.value(state="admitted"))
+
+    @property
+    def completed(self) -> int:
+        return int(self._requests.value(state="completed"))
+
+    @property
+    def cancelled(self) -> int:
+        return int(self._requests.value(state="cancelled"))
+
+    @property
+    def expired(self) -> int:
+        return int(self._requests.value(state="expired"))
+
+    @property
+    def steps(self) -> int:
+        return int(self._steps.value())
+
+    @property
+    def windows(self) -> int:
+        return int(self._windows.value())
+
+    @property
+    def tokens_generated(self) -> int:
+        return int(self._tokens.value())
+
+    @property
+    def busy_time_s(self) -> float:
+        return self._busy.value()
+
+    @property
+    def last_queue_depth(self) -> int:
+        v = self._queue_depth.value()
+        return int(v) if v is not None else 0
+
+    @property
+    def ckpt_load_retries(self) -> int:
+        v = self._ckpt_retries.value()
+        return int(v) if v is not None else 0
+
+    @ckpt_load_retries.setter
+    def ckpt_load_retries(self, v: int) -> None:
+        self._ckpt_retries.set(v)
+
+    @property
+    def last_retry_after_s(self) -> Optional[float]:
+        return self._retry_after.value()
+
+    @property
+    def ttft_s(self) -> List[float]:
+        return self._ttft.samples()
+
+    @property
+    def latency_s(self) -> List[float]:
+        return self._latency.samples()
+
+    @property
+    def queue_wait_s(self) -> List[float]:
+        return self._queue_wait.samples()
+
+    @property
+    def step_latency_s(self) -> List[float]:
+        return self._step_latency.samples()
 
     # -- reporting ---------------------------------------------------------
 
     @property
     def tokens_per_sec(self) -> Optional[float]:
-        if self.busy_time_s <= 0:
+        busy = self.busy_time_s
+        if busy <= 0:
             return None
-        return self.tokens_generated / self.busy_time_s
+        return self.tokens_generated / busy
 
     @property
     def mean_slot_occupancy(self) -> Optional[float]:
-        if self.steps == 0:
+        steps = self.steps
+        if steps == 0:
             return None
-        return self._occupancy_sum / self.steps
+        return self._occupancy_sum_c.value() / steps
 
     @property
     def mean_steps_per_window(self) -> Optional[float]:
-        if self.windows == 0:
+        windows = self.windows
+        if windows == 0:
             return None
-        return self.steps / self.windows
+        return self.steps / windows
 
     def snapshot(self) -> Dict:
         return {
@@ -158,14 +236,14 @@ class ServeMetrics:
             "serve_tokens_per_sec": self.tokens_per_sec,
             "serve_ckpt_load_retries": self.ckpt_load_retries,
             "serve_retry_after_hint_s": self.last_retry_after_s,
-            "serve_queue_wait_p50_s": percentile(self.queue_wait_s, 50),
-            "serve_queue_wait_p95_s": percentile(self.queue_wait_s, 95),
-            "serve_ttft_p50_s": percentile(self.ttft_s, 50),
-            "serve_ttft_p95_s": percentile(self.ttft_s, 95),
-            "serve_latency_p50_s": percentile(self.latency_s, 50),
-            "serve_latency_p95_s": percentile(self.latency_s, 95),
-            "serve_step_latency_p50_s": percentile(self.step_latency_s, 50),
-            "serve_step_latency_p95_s": percentile(self.step_latency_s, 95),
+            "serve_queue_wait_p50_s": self._queue_wait.percentile(50),
+            "serve_queue_wait_p95_s": self._queue_wait.percentile(95),
+            "serve_ttft_p50_s": self._ttft.percentile(50),
+            "serve_ttft_p95_s": self._ttft.percentile(95),
+            "serve_latency_p50_s": self._latency.percentile(50),
+            "serve_latency_p95_s": self._latency.percentile(95),
+            "serve_step_latency_p50_s": self._step_latency.percentile(50),
+            "serve_step_latency_p95_s": self._step_latency.percentile(95),
             "serve_uptime_s": self._clock() - self.started_at,
         }
 

@@ -33,7 +33,9 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-from .metrics import percentile
+# The percentile math is the obs subsystem's shared implementation (the
+# same function serve/metrics.py re-exports).
+from ..obs.metrics import percentile
 
 
 class RequestState(enum.Enum):

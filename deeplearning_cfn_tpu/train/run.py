@@ -16,6 +16,7 @@ import jax
 
 from ..ckpt import CheckpointManager, retry_policy_from_config
 from ..config import ExperimentConfig
+from ..obs import JsonlSink, get_tracer, obs_enabled, write_prometheus
 from ..runtime.faults import chaos_kill_hook_from_env
 from ..data import build_pipeline
 from ..metrics import MetricsWriter
@@ -171,6 +172,15 @@ def run_experiment(
     trainer = _build_trainer(cfg, task, tx, mesh)
     metrics_path = os.path.join(workdir, "metrics.jsonl")
     writer = MetricsWriter(metrics_path)
+    # Span records (train.dispatch/realize/eval, ckpt.save/restore/retry)
+    # flow into the SAME metrics.jsonl — additive lines with a "span" key,
+    # not on stdout (spans are high-rate; stdout stays the human stream).
+    # Existing keys keep their bytes.
+    span_sink = None
+    if obs_enabled():
+        span_sink = JsonlSink(MetricsWriter(metrics_path,
+                                            also_stdout=False))
+        get_tracer().add_sink(span_sink)
     if jax.process_index() == 0:
         print(f"[dlcfn-tpu] {describe(mesh)}")
         print(f"[dlcfn-tpu] total_steps={total_steps} "
@@ -189,31 +199,42 @@ def run_experiment(
         hooks.append(chaos_hook)
 
     eval_every = cfg.train.eval_every_steps or steps_per_epoch
-    state = trainer.fit(
-        state,
-        train_pipe.epochs(start_epoch=int(state.step) // steps_per_epoch,
-                          skip_batches=int(state.step) % steps_per_epoch),
-        num_steps=total_steps,
-        rng=train_rng,
-        eval_iter_fn=lambda: eval_pipe.one_epoch(),
-        eval_every=eval_every,
-        hooks=tuple(hooks),
-        # Step windows must land exactly on the save cadence — the
-        # manager's own should_save(step) check only fires on multiples.
-        hook_every=ckpt_every,
-        log_every=cfg.train.log_every_steps,
-        metrics_writer=writer,
-        trace_dir=os.path.join(workdir, "profile")
-        if cfg.train.profile_steps > 0 else None,
-        trace_steps=cfg.train.profile_steps,
-    )
-    manager.save(int(state.step), state, force=True)
-    manager.wait()
+    try:
+        state = trainer.fit(
+            state,
+            train_pipe.epochs(start_epoch=int(state.step) // steps_per_epoch,
+                              skip_batches=int(state.step) % steps_per_epoch),
+            num_steps=total_steps,
+            rng=train_rng,
+            eval_iter_fn=lambda: eval_pipe.one_epoch(),
+            eval_every=eval_every,
+            hooks=tuple(hooks),
+            # Step windows must land exactly on the save cadence — the
+            # manager's own should_save(step) check only fires on multiples.
+            hook_every=ckpt_every,
+            log_every=cfg.train.log_every_steps,
+            metrics_writer=writer,
+            trace_dir=os.path.join(workdir, "profile")
+            if cfg.train.profile_steps > 0 else None,
+            trace_steps=cfg.train.profile_steps,
+        )
+        manager.save(int(state.step), state, force=True)
+        manager.wait()
 
-    final = _final_eval(cfg, task, trainer, state, eval_pipe)
-    writer.write({"step": int(state.step),
-                  "ckpt_store_retries": manager.store_retries(),
-                  **{f"final_eval_{k}": v for k, v in final.items()}})
-    writer.close()
+        final = _final_eval(cfg, task, trainer, state, eval_pipe)
+        writer.write({"step": int(state.step),
+                      "ckpt_store_retries": manager.store_retries(),
+                      **{f"final_eval_{k}": v for k, v in final.items()}})
+    finally:
+        writer.close()
+        if span_sink is not None:
+            get_tracer().remove_sink(span_sink)
+            span_sink.close()
+        if obs_enabled() and jax.process_index() == 0:
+            # One end-of-run Prometheus text snapshot of every instrument
+            # the tracer's registry accumulated (span_dur_s histograms
+            # included) — scrape-by-file, no server.
+            write_prometheus(get_tracer().registry,
+                             os.path.join(workdir, "metrics.prom"))
     del data_rng
     return final

@@ -25,6 +25,7 @@ import optax
 from jax.sharding import Mesh
 
 from ..config import ExperimentConfig
+from ..obs.trace import span
 from ..parallel.mesh import build_mesh, validate_batch
 from ..parallel.sharding import batch_sharding, replicated
 from .state import TrainState
@@ -474,14 +475,19 @@ class Trainer:
                 k = 1 if K == 1 else _plan_window(
                     step, num_steps, K, cadences,
                     (trace_start, trace_stop))
-                if k == 1:
-                    # Per-step program — also the remainder path when a
-                    # window clamps to one step.
-                    state, metrics = self.train_step(
-                        state, next_batch(), rng)
-                else:
-                    batches = tuple(next_batch() for _ in range(k))
-                    state, metrics = self.window_step(state, batches, rng)
+                # The span brackets DISPATCH (async — not device time;
+                # honest step time is the boundary-derived step_time_s
+                # key below). DLCFN_OBS_OFF=1 makes this a shared no-op.
+                with span("train.dispatch", step=step, k=k):
+                    if k == 1:
+                        # Per-step program — also the remainder path when
+                        # a window clamps to one step.
+                        state, metrics = self.train_step(
+                            state, next_batch(), rng)
+                    else:
+                        batches = tuple(next_batch() for _ in range(k))
+                        state, metrics = self.window_step(
+                            state, batches, rng)
                 prev, last = last, (step + k - 1, metrics)
                 window_examples += gb * k
                 step += k
@@ -520,11 +526,12 @@ class Trainer:
                             to_realize.append(last)
                     first_write = True
                     for w_end, w_metrics in to_realize:
-                        realized = {
-                            k_: float(np.asarray(v).reshape(-1)[-1])
-                            for k_, v in
-                            jax.device_get(w_metrics).items()
-                        }
+                        with span("train.realize", step=w_end + 1):
+                            realized = {
+                                k_: float(np.asarray(v).reshape(-1)[-1])
+                                for k_, v in
+                                jax.device_get(w_metrics).items()
+                            }
                         if first_write:
                             # Throughput covers everything dispatched
                             # since the last written boundary; the final
@@ -537,6 +544,12 @@ class Trainer:
                                 realized["examples_per_sec_per_device"] = (
                                     realized["examples_per_sec"]
                                     / self.mesh.devices.size
+                                )
+                                # Additive key (obs report feed): honest
+                                # synced per-step wall time over the same
+                                # post-compile window as examples_per_sec.
+                                realized["step_time_s"] = (
+                                    elapsed / max(window_examples // gb, 1)
                                 )
                             window_start = time.perf_counter()
                             window_examples = 0
@@ -576,9 +589,10 @@ class Trainer:
                     and eval_every > 0
                     and step % eval_every == 0
                 ):
-                    eval_metrics = self.evaluate(state, eval_iter_fn(),
-                                                 eval_steps,
-                                                 watchdog=watchdog)
+                    with span("train.eval", step=step):
+                        eval_metrics = self.evaluate(state, eval_iter_fn(),
+                                                     eval_steps,
+                                                     watchdog=watchdog)
                     if metrics_writer is not None:
                         metrics_writer.write(
                             {"step": step, **{f"eval_{k}": v

@@ -2,6 +2,7 @@
 auto-restart (the fault-injection tier SURVEY.md §6 specifies — the reference
 had no equivalent: its Horovod jobs hung on node loss)."""
 
+import json
 import os
 import sys
 
@@ -53,9 +54,16 @@ def test_per_host_logs_aggregated(tmp_path):
     result = launcher.run(_spec(2), _py(code), str(tmp_path / "logs"))
     assert result.success
     logs = sorted(os.listdir(result.log_dir))
-    assert logs == ["attempt0-host0.log", "attempt0-host1.log"]
+    assert logs == ["attempt0-host0.log", "attempt0-host1.log",
+                    "launch.jsonl"]
     text0 = (tmp_path / "logs" / logs[0]).read_text()
     assert "hello from rank 0" in text0
+    # Attempt lifecycle events land next to the host logs (obs report feed).
+    (event,) = [json.loads(line) for line in
+                (tmp_path / "logs" / "launch.jsonl").read_text().splitlines()]
+    assert event["event"] == "launch_attempt"
+    assert event["attempt"] == 0 and event["outcome"] == "ok"
+    assert event["success"] is True and event["exit_codes"] == [0, 0]
 
 
 def test_failure_kills_survivors_fast(tmp_path):

@@ -1,0 +1,640 @@
+"""obs/ subsystem: metrics registry, span tracer, sinks, run reports.
+
+Parity tests pin the migration contracts: ServeMetrics keeps its exact
+attribute surface and snapshot keys/values after moving onto the registry,
+and percentile() keeps its interpolation semantics. The report tests run
+against COMMITTED fixture logs generated from real train/serve/launcher
+runs (tests/fixtures/obs/), so `obs summarize` is tested on the actual
+byte shapes the runners emit.
+"""
+
+import json
+import os
+
+import pytest
+
+from deeplearning_cfn_tpu.metrics.jsonl import MetricsWriter
+from deeplearning_cfn_tpu.obs import (
+    JsonlSink,
+    MemorySink,
+    MetricsRegistry,
+    Tracer,
+    configured,
+    exponential_buckets,
+    get_tracer,
+    obs_enabled,
+    percentile,
+    render_prometheus,
+    render_report,
+    set_enabled,
+    span,
+    summarize,
+    write_prometheus,
+)
+from deeplearning_cfn_tpu.serve.metrics import ServeMetrics
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "obs")
+
+
+# -- percentile edge cases (satellite: never raise, never NaN) ---------------
+
+
+def test_percentile_empty_returns_none():
+    assert percentile([], 50) is None
+    assert percentile([], 95) is None
+
+
+def test_percentile_single_sample_is_that_sample():
+    for q in (0, 50, 95, 100):
+        assert percentile([0.25], q) == 0.25
+
+
+def test_percentile_all_ties_no_nan():
+    p = percentile([2.0] * 7, 95)
+    assert p == 2.0
+    assert p == p  # not NaN
+
+
+def test_percentile_interpolates():
+    # rank = (n-1) * q/100; for [1..5], p50 = 3.0, p95 = 4.8
+    xs = [5.0, 1.0, 4.0, 2.0, 3.0]
+    assert percentile(xs, 50) == 3.0
+    assert percentile(xs, 95) == pytest.approx(4.8)
+
+
+def test_percentile_does_not_mutate_input():
+    xs = [3.0, 1.0, 2.0]
+    percentile(xs, 50)
+    assert xs == [3.0, 1.0, 2.0]
+
+
+# -- registry + instruments --------------------------------------------------
+
+
+def test_counter_inc_and_monotonicity():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs", "requests")
+    assert c.value() == 0
+    c.inc()
+    c.inc(3)
+    assert c.value() == 4
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_counter_labels_are_independent_series():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs", "requests")
+    c.inc(2, state="ok")
+    c.inc(5, state="err")
+    assert c.value(state="ok") == 2
+    assert c.value(state="err") == 5
+    assert c.labels(state="ok").value() == 2
+    assert c.series()[(("state", "ok"),)] == 2
+
+
+def test_gauge_set_and_inc():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth", "queue depth")
+    assert g.value() is None
+    g.set(7)
+    assert g.value() == 7
+    g.inc(-2)
+    assert g.value() == 5
+
+
+def test_registry_get_or_create_returns_same_instrument():
+    reg = MetricsRegistry()
+    assert reg.counter("x", "d") is reg.counter("x", "d")
+
+
+def test_registry_kind_mismatch_raises_typeerror():
+    reg = MetricsRegistry()
+    reg.counter("x", "d")
+    with pytest.raises(TypeError):
+        reg.gauge("x", "d")
+    with pytest.raises(TypeError):
+        reg.histogram("x", "d")
+
+
+def test_exponential_buckets_shape():
+    assert exponential_buckets(start=1e-3, factor=2.0, count=4) == \
+        (1e-3, 2e-3, 4e-3, 8e-3)
+    with pytest.raises(ValueError):
+        exponential_buckets(start=0)
+
+
+def test_histogram_buckets_and_exact_percentiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", "latency", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.count() == 3
+    assert h.sum() == pytest.approx(5.55)
+    # exact percentiles come from retained samples, not bucket edges
+    assert h.percentile(50) == 0.5
+    assert h.samples() == [0.05, 0.5, 5.0]
+    ((_, series),) = h.series().items()
+    assert series.bucket_counts == [1, 1, 1]  # per-bucket incl +Inf
+
+
+def test_histogram_empty_percentile_is_none():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", "latency")
+    assert h.percentile(50) is None
+    assert h.mean() is None
+    assert h.count() == 0 and h.sum() == 0.0 and h.samples() == []
+
+
+def test_histogram_keep_samples_false_drops_raw_series():
+    reg = MetricsRegistry()
+    h = reg.histogram("hot", "hot path", keep_samples=False)
+    h.observe(0.2)
+    assert h.count() == 1
+    assert h.samples() == []
+    assert h.percentile(50) is None  # no raw series -> no exact percentile
+
+
+def test_histogram_labelled_series():
+    reg = MetricsRegistry()
+    h = reg.histogram("span_dur_s", "d")
+    h.observe(0.1, name="a")
+    h.observe(0.2, name="a")
+    h.observe(9.0, name="b")
+    assert h.count(name="a") == 2
+    assert h.percentile(50, name="a") == pytest.approx(0.15)
+    assert h.count(name="b") == 1
+
+
+def test_registry_snapshot_is_json_able():
+    reg = MetricsRegistry()
+    reg.counter("c", "c").inc(2, state="ok")
+    reg.gauge("g", "g").set(1.5)
+    reg.histogram("h", "h").observe(0.2)
+    snap = json.loads(json.dumps(reg.snapshot()))
+    assert snap["c"]["kind"] == "counter"
+    assert snap["c"]["series"]["state=ok"] == 2
+    assert snap["h"]["series"][""]["count"] == 1
+    assert snap["h"]["series"][""]["p50"] == 0.2
+
+
+# -- span tracer -------------------------------------------------------------
+
+
+@pytest.fixture()
+def fresh_tracer():
+    t = Tracer()
+    configured(t)
+    try:
+        yield t
+    finally:
+        configured(None)
+        set_enabled(None)
+
+
+def test_span_ids_deterministic_from_one(fresh_tracer):
+    sink = MemorySink()
+    fresh_tracer.add_sink(sink)
+    with span("a"):
+        pass
+    with span("b"):
+        pass
+    assert [r["span_id"] for r in sink.records] == [1, 2]
+    assert all(r["parent_id"] is None for r in sink.records)
+
+
+def test_span_nesting_sets_parent_id(fresh_tracer):
+    sink = MemorySink()
+    fresh_tracer.add_sink(sink)
+    with span("outer"):
+        with span("inner", step=3):
+            pass
+    inner, outer = sink.records  # inner closes (and is recorded) first
+    assert inner["span"] == "inner"
+    assert inner["parent_id"] == outer["span_id"]
+    assert inner["step"] == 3
+    assert outer["parent_id"] is None
+    assert inner["dur_s"] <= outer["dur_s"]
+    assert inner["t0_s"] >= outer["t0_s"]
+
+
+def test_span_records_failure_and_reraises(fresh_tracer):
+    sink = MemorySink()
+    fresh_tracer.add_sink(sink)
+    with pytest.raises(RuntimeError):
+        with span("boom"):
+            raise RuntimeError("x")
+    (rec,) = sink.records
+    assert rec["ok"] is False
+
+
+def test_span_annotate_adds_attrs(fresh_tracer):
+    sink = MemorySink()
+    fresh_tracer.add_sink(sink)
+    with span("ckpt.save", step=4) as sp:
+        sp.annotate(retries=2)
+    (rec,) = sink.records
+    assert rec["step"] == 4
+    assert rec["retries"] == 2
+
+
+def test_spans_feed_duration_histogram(fresh_tracer):
+    with span("work"):
+        pass
+    h = fresh_tracer.registry.histogram("span_dur_s", "span durations by name")
+    assert h.count(name="work") == 1
+
+
+def test_memory_sink_by_span(fresh_tracer):
+    sink = MemorySink()
+    fresh_tracer.add_sink(sink)
+    with span("a"):
+        pass
+    with span("b"):
+        pass
+    assert [r["span"] for r in sink.by_span("a")] == ["a"]
+
+
+def test_env_gate_disables_spans(fresh_tracer, monkeypatch):
+    sink = MemorySink()
+    fresh_tracer.add_sink(sink)
+    monkeypatch.setenv("DLCFN_OBS_OFF", "1")
+    assert not obs_enabled()
+    with span("a") as sp:
+        sp.annotate(ignored=True)  # null span: no-op, no raise
+    assert sink.records == []
+    monkeypatch.delenv("DLCFN_OBS_OFF")
+    assert obs_enabled()
+    with span("a"):
+        pass
+    assert len(sink.records) == 1
+
+
+def test_set_enabled_overrides_env(fresh_tracer, monkeypatch):
+    sink = MemorySink()
+    fresh_tracer.add_sink(sink)
+    monkeypatch.setenv("DLCFN_OBS_OFF", "1")
+    set_enabled(True)  # programmatic override beats the env var
+    with span("a"):
+        pass
+    assert len(sink.records) == 1
+    set_enabled(False)
+    with span("b"):
+        pass
+    assert len(sink.records) == 1
+
+
+def test_get_tracer_returns_configured_default():
+    t = Tracer()
+    configured(t)
+    try:
+        assert get_tracer() is t
+    finally:
+        configured(None)
+    assert get_tracer() is not t
+
+
+def test_remove_sink_stops_delivery(fresh_tracer):
+    sink = MemorySink()
+    fresh_tracer.add_sink(sink)
+    fresh_tracer.remove_sink(sink)
+    fresh_tracer.remove_sink(sink)  # idempotent
+    with span("a"):
+        pass
+    assert sink.records == []
+
+
+# -- sinks -------------------------------------------------------------------
+
+
+def test_jsonl_sink_writes_span_records(fresh_tracer, tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    sink = JsonlSink(MetricsWriter(path, also_stdout=False))
+    fresh_tracer.add_sink(sink)
+    with span("a", step=1):
+        pass
+    sink.close()
+    (line,) = open(path).read().splitlines()
+    rec = json.loads(line)
+    assert rec["span"] == "a" and rec["span_id"] == 1 and "ts" in rec
+
+
+def test_render_prometheus_text_format():
+    reg = MetricsRegistry()
+    reg.counter("reqs_total", "requests").inc(3, state="ok")
+    reg.gauge("depth", "queue depth").set(2)
+    h = reg.histogram("lat_s", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    text = render_prometheus(reg)
+    assert "# TYPE reqs_total counter" in text
+    assert 'reqs_total{state="ok"} 3' in text
+    assert "# TYPE depth gauge" in text
+    assert "depth 2" in text
+    assert 'lat_s_bucket{le="0.1"} 1' in text
+    assert 'lat_s_bucket{le="1"} 2' in text
+    assert 'lat_s_bucket{le="+Inf"} 2' in text
+    assert "lat_s_count 2" in text
+    assert "lat_s_sum 0.55" in text
+
+
+def test_render_prometheus_escapes_label_values():
+    reg = MetricsRegistry()
+    reg.counter("c", "d").inc(1, msg='a"b\nc\\d')
+    text = render_prometheus(reg)
+    assert 'msg="a\\"b\\nc\\\\d"' in text
+
+
+def test_write_prometheus_atomic(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("c", "d").inc()
+    path = str(tmp_path / "metrics.prom")
+    text = write_prometheus(reg, path)
+    assert open(path).read() == text
+    assert os.listdir(str(tmp_path)) == ["metrics.prom"]  # no tmp leftover
+
+
+# -- ServeMetrics parity after the registry migration ------------------------
+
+
+def _drive(m: ServeMetrics):
+    m.record_submit()
+    m.record_submit()
+    m.record_admit(queue_wait_s=0.5)
+    m.record_admit(queue_wait_s=1.5)
+    m.record_first_token(0.2)
+    m.record_finish("done", 2.0)
+    m.record_step(active_rows=2, queue_depth=3, new_tokens=5,
+                  step_time_s=0.01)
+    m.record_step(active_rows=1, queue_depth=3, new_tokens=3,
+                  step_time_s=0.03)
+    m.record_reject(retry_after_s=0.25)
+
+
+def test_serve_metrics_attribute_surface_parity():
+    m = ServeMetrics(capacity=4, clock=lambda: 0.0)
+    _drive(m)
+    # the exact pre-migration attribute surface, live values
+    assert m.submitted == 2 and isinstance(m.submitted, int)
+    assert m.admitted == 2
+    assert m.completed == 1
+    assert m.rejected == 1
+    assert m.cancelled == 0 and m.expired == 0
+    assert m.tokens_generated == 8
+    assert m.steps == 2 and m.windows == 2
+    assert m.queue_wait_s == [0.5, 1.5]
+    assert m.ttft_s == [0.2]
+    assert m.latency_s == [2.0]
+    assert m.step_latency_s == [0.01, 0.03]
+    assert m.busy_time_s == pytest.approx(0.04)
+    assert m.last_queue_depth == 3
+    assert m.last_retry_after_s == 0.25
+    assert m.mean_slot_occupancy == pytest.approx(0.375)
+    assert m.mean_steps_per_window == 1.0
+    assert m.tokens_per_sec == pytest.approx(8 / 0.04)
+    assert m.ckpt_load_retries == 0
+
+
+def test_serve_metrics_snapshot_keys_and_values_parity():
+    m = ServeMetrics(capacity=4, clock=lambda: 0.0)
+    _drive(m)
+    snap = m.snapshot()
+    # key set is the pre-migration JSONL contract
+    assert set(snap) == {
+        "serve_submitted", "serve_rejected", "serve_admitted",
+        "serve_completed", "serve_cancelled", "serve_expired",
+        "serve_steps", "serve_decode_windows", "serve_steps_per_window",
+        "serve_queue_depth", "serve_slot_capacity", "serve_slot_occupancy",
+        "serve_tokens_generated", "serve_tokens_per_sec",
+        "serve_ckpt_load_retries", "serve_retry_after_hint_s",
+        "serve_queue_wait_p50_s", "serve_queue_wait_p95_s",
+        "serve_ttft_p50_s", "serve_ttft_p95_s",
+        "serve_latency_p50_s", "serve_latency_p95_s",
+        "serve_step_latency_p50_s", "serve_step_latency_p95_s",
+        "serve_uptime_s",
+    }
+    # counters serialize as ints (1 not 1.0) — the byte-compat contract
+    for k in ("serve_submitted", "serve_admitted", "serve_completed",
+              "serve_rejected", "serve_tokens_generated", "serve_steps",
+              "serve_decode_windows", "serve_queue_depth",
+              "serve_ckpt_load_retries"):
+        assert isinstance(snap[k], int), k
+    # percentiles are the exact list-based values, not bucket estimates
+    assert snap["serve_queue_wait_p50_s"] == percentile([0.5, 1.5], 50)
+    assert snap["serve_queue_wait_p95_s"] == percentile([0.5, 1.5], 95)
+    assert snap["serve_step_latency_p50_s"] == percentile([0.01, 0.03], 50)
+    assert snap["serve_ttft_p50_s"] == 0.2
+    assert snap["serve_latency_p95_s"] == 2.0
+
+
+def test_serve_metrics_empty_percentiles_are_none():
+    snap = ServeMetrics(capacity=2).snapshot()
+    assert snap["serve_queue_wait_p50_s"] is None
+    assert snap["serve_ttft_p95_s"] is None
+    assert snap["serve_tokens_per_sec"] is None
+
+
+def test_serve_metrics_ckpt_load_retries_settable():
+    m = ServeMetrics(capacity=2)
+    m.ckpt_load_retries = 3  # serve/loader.py assigns this directly
+    assert m.ckpt_load_retries == 3
+    assert m.snapshot()["serve_ckpt_load_retries"] == 3
+
+
+def test_serve_metrics_registry_is_queryable():
+    m = ServeMetrics(capacity=2)
+    _drive(m)
+    c = m.registry.counter("serve_requests_total",
+                           "request lifecycle events by state")
+    assert c.value(state="submitted") == 2
+    assert c.value(state="admitted") == 2
+
+
+def test_serve_metrics_instances_do_not_share_state():
+    a, b = ServeMetrics(capacity=2), ServeMetrics(capacity=2)
+    a.record_submit()
+    assert a.submitted == 1 and b.submitted == 0
+
+
+# -- StepTimer on the registry ----------------------------------------------
+
+
+def _fake_clock(monkeypatch, ticks):
+    from deeplearning_cfn_tpu.runtime import profiling
+
+    it = iter(ticks)
+    monkeypatch.setattr(profiling.time, "perf_counter", lambda: next(it))
+
+
+def test_step_timer_summary_has_percentiles(monkeypatch):
+    from deeplearning_cfn_tpu.runtime.profiling import StepTimer
+
+    _fake_clock(monkeypatch, [0.0, 1.0, 1.0, 2.0, 2.0, 3.5, 3.5, 4.0])
+    t = StepTimer(warmup=1)
+    for _ in range(4):
+        t.start()
+        t.stop()
+    s = t.summary()
+    assert t.steps == 3
+    assert s["steps"] == 3
+    assert s["mean_step_s"] == pytest.approx(1.0)
+    assert s["p50_step_s"] == 1.0
+    assert s["p95_step_s"] == pytest.approx(1.45)
+    assert s["min_step_s"] == 0.5 and s["max_step_s"] == 1.5
+
+
+def test_step_timer_feeds_registry_histogram(monkeypatch):
+    from deeplearning_cfn_tpu.runtime.profiling import StepTimer
+
+    _fake_clock(monkeypatch, [0.0, 1.0])
+    reg = MetricsRegistry()
+    t = StepTimer(warmup=0, registry=reg)
+    t.start()
+    t.stop()
+    h = reg.histogram("step_time_s", "synced per-step wall time")
+    assert h.count() == 1 and h.samples() == [1.0]
+
+
+def test_step_timer_empty_summary():
+    from deeplearning_cfn_tpu.runtime.profiling import StepTimer
+
+    assert StepTimer().summary() == {"steps": 0}
+
+
+# -- trace_steps hardening ---------------------------------------------------
+
+
+def test_trace_steps_body_error_not_masked_by_stop(monkeypatch, tmp_path):
+    from deeplearning_cfn_tpu.runtime import profiling
+
+    monkeypatch.setattr(profiling.jax.profiler, "start_trace",
+                        lambda d: None)
+
+    def bad_stop():
+        raise OSError("flush failed")
+
+    monkeypatch.setattr(profiling.jax.profiler, "stop_trace", bad_stop)
+    # body error wins; stop_trace's secondary failure is swallowed
+    with pytest.raises(ValueError, match="body"):
+        with profiling.trace_steps(str(tmp_path)):
+            raise ValueError("body")
+    # body succeeded -> stop_trace failure must surface
+    with pytest.raises(OSError, match="flush"):
+        with profiling.trace_steps(str(tmp_path)):
+            pass
+
+
+# -- lazy MetricsWriter (satellite: no jax at construction) ------------------
+
+
+def test_metrics_writer_construction_is_side_effect_free(tmp_path):
+    path = str(tmp_path / "sub" / "m.jsonl")
+    w = MetricsWriter(path, also_stdout=False)
+    # no file, no directory until the first write
+    assert not os.path.exists(os.path.dirname(path))
+    w.write({"a": 1})
+    w.close()
+    assert json.loads(open(path).read())["a"] == 1
+
+
+def test_metrics_writer_all_processes_never_asks_jax(tmp_path):
+    w = MetricsWriter(str(tmp_path / "m.jsonl"), also_stdout=False,
+                      all_processes=True)
+    assert w.enabled  # resolved without touching jax.process_index()
+
+
+# -- run reports over committed fixture logs ---------------------------------
+
+
+def test_summarize_train_fixture_dir():
+    s = summarize(os.path.join(FIXTURES, "train"))
+    assert s["source"]["files"] == 2
+    assert s["source"]["records"] == 25
+    assert s["source"]["skipped_lines"] == 0
+    tr = s["train"]
+    assert tr["last_step"] == 6
+    assert 0.2 < tr["step_time_s"]["p50"] < 0.31
+    assert tr["step_time_s"]["p95"] >= tr["step_time_s"]["p50"]
+    assert tr["examples_per_sec"]["last"] == pytest.approx(115.15, abs=0.01)
+    assert tr["examples_per_sec"]["peak"] == pytest.approx(118.36, abs=0.01)
+    assert tr["loss"]["first"] == pytest.approx(2.3026, abs=1e-3)
+    assert tr["compile_s"] == pytest.approx(5.258, abs=1e-2)
+    assert tr["ckpt_store_retries"] == 0
+    assert tr["eval"]["final_eval_accuracy"] == 0.125
+    sp = s["spans"]
+    assert sp["ckpt.save"]["count"] == 4  # steps 2,4,6 + final forced save
+    assert "failed" not in sp["ckpt.save"]  # no failures recorded
+    assert sp["train.dispatch"]["count"] == 6
+    assert sp["train.realize"]["count"] == 6
+    la = s["launch"]
+    assert la["attempts"] == 2
+    assert la["outcomes"] == ["crash", "ok"]
+    assert la["success"] is True and la["restarts"] == 1
+
+
+def test_summarize_serve_fixture_file():
+    s = summarize(os.path.join(FIXTURES, "serve", "metrics.jsonl"))
+    assert s["source"]["files"] == 1
+    sv = s["serve"]
+    assert sv["submitted"] == 4 and sv["admitted"] == 4
+    assert sv["completed"] == 4 and sv["rejected"] == 0
+    assert sv["tokens_generated"] == 16
+    assert sv["tokens_per_sec"] > 0
+    assert sv["queue_wait_s"]["p50"] > 0
+    assert sv["ttft_s"]["p95"] >= sv["ttft_s"]["p50"]
+    assert s["spans"]["serve.decode"]["count"] == 4
+    assert s["spans"]["serve.admit"]["count"] == 4
+    assert "train" not in s
+
+
+def test_render_report_is_human_text():
+    s = summarize(os.path.join(FIXTURES, "train"))
+    text = render_report(s)
+    assert "run report:" in text
+    assert "last step" in text
+    assert "launch:" in text and "crash, ok" in text
+
+
+def test_summarize_skips_malformed_lines(tmp_path):
+    p = tmp_path / "m.jsonl"
+    p.write_text('{"step": 1, "loss": 2.0}\nnot json\n{"step": 2}\n')
+    s = summarize(str(p))
+    assert s["source"]["records"] == 2
+    assert s["source"]["skipped_lines"] == 1
+    assert s["train"]["last_step"] == 2
+
+
+def test_summarize_empty_input(tmp_path):
+    p = tmp_path / "m.jsonl"
+    p.write_text("")
+    s = summarize(str(p))
+    assert s["source"]["records"] == 0
+    assert "no train" in render_report(s)  # renders, no raise
+
+
+# -- CLI verb ----------------------------------------------------------------
+
+
+def test_cli_obs_summarize(capsys):
+    from deeplearning_cfn_tpu.cli.main import main
+
+    rc = main(["obs", "summarize", os.path.join(FIXTURES, "train")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "run report:" in out and "last step" in out
+
+
+def test_cli_obs_summarize_json(capsys):
+    from deeplearning_cfn_tpu.cli.main import main
+
+    rc = main(["obs", "summarize", "--json",
+               os.path.join(FIXTURES, "serve", "metrics.jsonl")])
+    assert rc == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["serve"]["completed"] == 4
+
+
+def test_cli_obs_summarize_missing_path(capsys):
+    from deeplearning_cfn_tpu.cli.main import main
+
+    assert main(["obs", "summarize", "/nonexistent/m.jsonl"]) == 1
