@@ -359,6 +359,10 @@ def _cmd_bench(args) -> int:
         line = run_serve_bench(num_requests=args.requests_count,
                                slots=args.slots, beam_size=args.beam_size,
                                decode_window=args.decode_window,
+                               kv_block_size=args.kv_block_size,
+                               kv_blocks=args.kv_blocks,
+                               prefix_cache=args.prefix_cache,
+                               prefix_dup=args.prefix_dup,
                                smoke=args.smoke)
         print(json.dumps(line))
         return 0
@@ -450,6 +454,8 @@ def _cmd_serve(args) -> int:
             cfg, capacity=args.slots, queue_depth=args.queue_depth,
             default_max_new_tokens=args.max_new_tokens,
             decode_window=args.decode_window,
+            kv_block_size=args.kv_block_size, kv_blocks=args.kv_blocks,
+            prefix_cache_size=args.prefix_cache,
             step=args.step, vocab=args.vocab, allow_init=args.allow_init)
     except (FileNotFoundError, ValueError) as e:
         print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
@@ -997,6 +1003,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "when no scheduling work is pending (1 = surface "
                          "every token; larger amortizes dispatch at the "
                          "cost of admission/eviction freshness)")
+    sv.add_argument("--kv-block-size", type=int, default=16,
+                    help="paged KV-cache block size in token positions; "
+                         "must divide the model max_len (0 = dense per-"
+                         "slot rows, the pre-paging layout)")
+    sv.add_argument("--kv-blocks", type=int, default=0,
+                    help="paged KV pool size in blocks (0 = match the "
+                         "dense layout's memory: slots x max_len worth "
+                         "plus the null sentinel)")
+    sv.add_argument("--prefix-cache", type=int, default=32,
+                    help="encoder prefix-cache entries, keyed on the "
+                         "padded source tokens (0 = disabled)")
     sv.add_argument("--vocab", default="",
                     help="BPE vocab.json — required for \"text\" requests")
     sv.add_argument("--step", type=int, default=0,
@@ -1068,6 +1085,19 @@ def build_parser() -> argparse.ArgumentParser:
     be.add_argument("--decode-window", type=int, default=4,
                     help="serving scenario: fused decode steps per device "
                          "call (1 = the host-driven per-token loop)")
+    be.add_argument("--kv-block-size", type=int, default=16,
+                    help="serving scenario: paged KV block size (0 = "
+                         "dense slot rows)")
+    be.add_argument("--kv-blocks", type=int, default=0,
+                    help="serving scenario: KV pool blocks (0 = match "
+                         "dense memory)")
+    be.add_argument("--prefix-cache", type=int, default=16,
+                    help="serving scenario: encoder prefix-cache entries "
+                         "(0 = disabled)")
+    be.add_argument("--prefix-dup", type=float, default=0.0,
+                    help="serving scenario: fraction of trace requests "
+                         "repeating the first source — exercises the "
+                         "prefix cache")
     be.add_argument("--smoke", action="store_true",
                     help="serving scenario: CI fast mode (few requests, "
                          "tiny budget, same record contract)")

@@ -56,6 +56,19 @@ class MultiHeadAttention(nn.Module):
     at a different depth, so a finished request's rows are recycled —
     restart a row at position 0 and the step bias hides whatever a prior
     occupant left above it — without stalling in-flight neighbours.
+
+    ``block_tables`` (with ``decode=True`` and ``decode_pos``) switches the
+    cache from one [B, H, max_decode_len, D] row per batch entry to a
+    shared **block pool** [kv_num_blocks, H, kv_block_size, D] — the
+    vLLM/PagedAttention layout. ``block_tables`` is [B, max_blocks] int32:
+    row b's logical position p lives in pool block
+    ``block_tables[b, p // kv_block_size]`` at offset ``p % kv_block_size``.
+    The caller (a host-side block allocator) owns the tables; block 0 is
+    conventionally a null sentinel that unbound table entries point at, so
+    writes from idle rows land there harmlessly and the step bias masks
+    whatever they left. With ``max_blocks * kv_block_size ==
+    max_decode_len`` the gathered K/V span equals the dense row, so the
+    attention output is bit-identical to the ``decode_pos`` path.
     """
 
     num_heads: int
@@ -73,7 +86,9 @@ class MultiHeadAttention(nn.Module):
     @nn.compact
     def __call__(self, x, kv=None, bias=None, causal=False,
                  deterministic=True, decode=False,
-                 max_decode_len: int = 0, decode_pos=None):
+                 max_decode_len: int = 0, decode_pos=None,
+                 block_tables=None, kv_num_blocks: int = 0,
+                 kv_block_size: int = 0):
         self_attention = kv is None
         kv = x if kv is None else kv
         features = x.shape[-1]
@@ -94,7 +109,52 @@ class MultiHeadAttention(nn.Module):
         q = split(dense("query")(x))
         k = split(dense("key")(kv))
         v = split(dense("value")(kv))
-        if decode and self_attention:
+        if decode and self_attention and block_tables is not None:
+            if kv_num_blocks <= 0 or kv_block_size <= 0:
+                raise ValueError(
+                    "paged decode needs kv_num_blocks and kv_block_size")
+            if decode_pos is None:
+                raise ValueError(
+                    "paged decode is per-row — pass decode_pos")
+            b = q.shape[0]
+            pool_shape = (kv_num_blocks, self.num_heads, kv_block_size,
+                          head_dim)
+            is_initialized = self.has_variable("cache", "cached_key")
+            ck = self.variable("cache", "cached_key",
+                               lambda: jnp.zeros(pool_shape, self.dtype))
+            cv = self.variable("cache", "cached_value",
+                               lambda: jnp.zeros(pool_shape, self.dtype))
+            if is_initialized:
+                # Row b's single-position K/V land in its current block:
+                # pool[block_tables[b, pos // bs], :, pos % bs]. Rows whose
+                # table entry is unbound write into the null block 0 —
+                # masked below, never attended.
+                rows = jnp.arange(b)
+                blk = block_tables[rows, decode_pos // kv_block_size]
+                off = decode_pos % kv_block_size
+                ck.value = ck.value.at[blk, :, off, :].set(
+                    k[:, :, 0, :].astype(self.dtype))
+                cv.value = cv.value.at[blk, :, off, :].set(
+                    v[:, :, 0, :].astype(self.dtype))
+            # Gather each row's K/V span through its block table. The
+            # gathered layout puts logical position p at index p, so with
+            # span == max_decode_len this is bit-identical to the dense
+            # per-row cache (masked positions contribute exactly 0).
+            max_blocks = block_tables.shape[1]
+            span = max_blocks * kv_block_size
+
+            def gathered(c):
+                g = c[block_tables]  # [B, MB, H, bs, D]
+                return g.transpose(0, 2, 1, 3, 4).reshape(
+                    b, self.num_heads, span, head_dim)
+
+            step_bias = jnp.where(
+                jnp.arange(span)[None, :] <= decode_pos[:, None],
+                0.0, -1e30)[:, None, None, :].astype(jnp.float32)
+            out = fused_attention(q, gathered(ck.value),
+                                  gathered(cv.value), bias=step_bias,
+                                  causal=False, implementation="reference")
+        elif decode and self_attention:
             if max_decode_len <= 0:
                 raise ValueError("decode=True needs max_decode_len")
             b = q.shape[0]
@@ -198,7 +258,9 @@ class TransformerLayer(nn.Module):
     @nn.compact
     def __call__(self, x, enc=None, self_bias=None, cross_bias=None,
                  causal=False, deterministic=True, decode=False,
-                 max_decode_len: int = 0, decode_pos=None):
+                 max_decode_len: int = 0, decode_pos=None,
+                 block_tables=None, kv_num_blocks: int = 0,
+                 kv_block_size: int = 0):
         ln = lambda name: nn.LayerNorm(
             dtype=self.dtype, param_dtype=jnp.float32, name=name)
         attn = lambda name: MultiHeadAttention(
@@ -218,7 +280,9 @@ class TransformerLayer(nn.Module):
             x, lambda y: attn("self_attn")(
                 y, bias=self_bias, causal=causal and not decode,
                 deterministic=deterministic, decode=decode,
-                max_decode_len=max_decode_len, decode_pos=decode_pos),
+                max_decode_len=max_decode_len, decode_pos=decode_pos,
+                block_tables=block_tables, kv_num_blocks=kv_num_blocks,
+                kv_block_size=kv_block_size),
             "self_attn")
         if self.cross_attention:
             if enc is None:

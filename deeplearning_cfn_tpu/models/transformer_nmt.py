@@ -170,6 +170,44 @@ class TransformerNMT(nn.Module):
         y = self.dec_norm(y)
         return self.embed.logits(y)
 
+    def decode_step_paged(self, tgt_id, enc, src_mask, pos, block_tables,
+                          *, num_blocks: int, block_size: int):
+        """Paged-KV form of :meth:`decode_step_at`: each decoder layer's
+        self-attention cache is a shared block pool
+        [num_blocks, H, block_size, D] instead of one dense
+        [B, H, max_len, D] row per batch entry, and ``block_tables``
+        [B, max_blocks] int32 maps row b's logical position p to pool block
+        ``block_tables[b, p // block_size]`` (transformer.MultiHeadAttention
+        paged mode). The serving engine owns the tables (host-side block
+        allocator, block 0 = null sentinel); with ``max_blocks * block_size
+        == max_len`` the step is bit-identical to :meth:`decode_step_at`.
+        Create the pool with ``model.init(...,
+        method=TransformerNMT.decode_step_paged)``.
+        """
+        pos_emb = jnp.take(self.embed.tgt_position, pos, axis=0)  # [B, H]
+        y = self.embed.token(tgt_id) + pos_emb[:, None, :]
+        y = self.embed.tgt_norm(y.astype(self.dtype))
+        cross_bias = padding_bias(src_mask)
+        for lyr in self.dec:
+            y = lyr(y, enc=enc, cross_bias=cross_bias, causal=True,
+                    deterministic=True, decode=True,
+                    max_decode_len=self.max_len, decode_pos=pos,
+                    block_tables=block_tables, kv_num_blocks=num_blocks,
+                    kv_block_size=block_size)
+        y = self.dec_norm(y)
+        return self.embed.logits(y)
+
+    def greedy_step_paged(self, tgt_id, enc, src_mask, pos, block_tables,
+                          *, num_blocks: int, block_size: int):
+        """Fused greedy variant of :meth:`decode_step_paged` — same
+        in-model argmax contract as :meth:`greedy_step_at`, over the
+        block-pool cache."""
+        logits = self.decode_step_paged(
+            tgt_id, enc, src_mask, pos, block_tables,
+            num_blocks=num_blocks, block_size=block_size)
+        return jnp.argmax(logits[:, 0, :].astype(jnp.float32),
+                          axis=-1).astype(jnp.int32)
+
     def greedy_step_at(self, tgt_id, enc, src_mask, pos):
         """Fused greedy variant of :meth:`decode_step_at`: the argmax runs
         in-model, so the step returns next-token ids [B] int32 and the

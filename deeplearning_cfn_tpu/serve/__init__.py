@@ -6,7 +6,11 @@ KV-cache decoder machinery (models/decoding.py, models/transformer_nmt.py):
 - :mod:`.engine` — continuous-batching scheduler over a fixed slot table of
   per-row KV-cache positions; greedy traffic runs a device-resident fast
   path (fused argmax step, `lax.scan` decode windows, donated KV cache,
-  batched admission prefill);
+  batched admission prefill); with ``kv_block_size > 0`` the decoder cache
+  is a paged block pool (block-table attention, token-budget admission);
+- :mod:`.blockpool` — host-side KV block allocator (refcounts, commit
+  ledger) behind the paged engine;
+- :mod:`.prefix` — LRU encoder-output cache keyed on padded source tokens;
 - :mod:`.queue` — bounded request lifecycle (submit/poll/cancel, deadlines,
   explicit overload rejection);
 - :mod:`.loader` — checkpoint restore + tokenizer binding;
@@ -17,8 +21,10 @@ KV-cache decoder machinery (models/decoding.py, models/transformer_nmt.py):
 CLI surface: `dlcfn-tpu serve --preset … --requests file.jsonl`.
 """
 
+from .blockpool import BlockAllocator, BlockPoolExhausted  # noqa: F401
 from .engine import Engine  # noqa: F401
 from .metrics import ServeMetrics, percentile  # noqa: F401
+from .prefix import PrefixCache  # noqa: F401
 from .queue import (  # noqa: F401
     OverloadError,
     Request,

@@ -37,15 +37,21 @@ DEFAULT_DECODE_WINDOW = 4
 
 
 def _fixed_trace(num_requests: int, src_len: int, vocab_size: int,
-                 reserved: int = 3, seed: int = 0):
+                 reserved: int = 3, seed: int = 0,
+                 prefix_dup: float = 0.0):
     """Deterministic request trace: seeded lengths + token ids, so every
-    run measures the same work."""
+    run measures the same work. ``prefix_dup`` is the fraction of follow-up
+    requests that repeat the first request's source (seeded draw) — the
+    knob that gives the encoder prefix cache something to hit."""
     rng = np.random.RandomState(seed)
     trace = []
     for _ in range(num_requests):
         n = int(rng.randint(max(2, src_len // 2), src_len + 1))
         ids = rng.randint(reserved, vocab_size, size=n).astype(np.int32)
         trace.append([int(t) for t in ids])
+    for i in range(1, num_requests):
+        if rng.rand() < prefix_dup:
+            trace[i] = list(trace[0])
     return trace
 
 
@@ -53,6 +59,8 @@ def run_serve_bench(num_requests: int = 16, slots: int = 4,
                     max_new_tokens: int = 16, beam_size: int = 1,
                     src_len: int = 12, seed: int = 0,
                     decode_window: int = DEFAULT_DECODE_WINDOW,
+                    kv_block_size: int = 16, kv_blocks: int = 0,
+                    prefix_cache: int = 16, prefix_dup: float = 0.0,
                     smoke: bool = False) -> Dict:
     """Run the fixed trace to drain; return the BENCH-contract record.
 
@@ -76,8 +84,11 @@ def run_serve_bench(num_requests: int = 16, slots: int = 4,
     engine = Engine(model, {"params": variables["params"]}, capacity=slots,
                     max_src_len=src_len, queue_depth=num_requests,
                     default_max_new_tokens=max_new_tokens,
-                    decode_window=decode_window)
-    trace = _fixed_trace(num_requests, src_len, 96, seed=seed)
+                    decode_window=decode_window,
+                    kv_block_size=kv_block_size, kv_blocks=kv_blocks,
+                    prefix_cache_size=prefix_cache)
+    trace = _fixed_trace(num_requests, src_len, 96, seed=seed,
+                         prefix_dup=prefix_dup)
     # Warmup outside the timed window: compiles the encoder, the fused
     # decode window (or the logits step for beam), and the admit scatter.
     engine.submit(trace[0], max_new_tokens=min(2, max_new_tokens),
@@ -127,5 +138,13 @@ def run_serve_bench(num_requests: int = 16, slots: int = 4,
         "decode_steps": m.steps,
         "smoke": smoke,
         "mean_slot_occupancy": round(m.mean_slot_occupancy or 0.0, 4),
+        "kv_block_size": kv_block_size,
+        "kv_blocks": engine.kv_blocks,
+        "kv_block_utilization": None if m.kv_block_utilization is None
+        else round(m.kv_block_utilization, 4),
+        "prefix_dup": prefix_dup,
+        "prefix_hit_rate": m.prefix_hit_rate,
+        "encoder_invocations": engine.encoder_invocations,
+        "admitted": m.admitted,
         "device": jax.default_backend(),
     }

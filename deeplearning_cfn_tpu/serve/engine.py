@@ -29,6 +29,32 @@ Row recycling needs no cache zeroing: the per-row step bias only exposes
 positions ``<= pos[row]``, so restarting a row at position 0 hides whatever
 a previous occupant wrote above it.
 
+**Paged KV mode** (``kv_block_size > 0``): instead of one dense
+[capacity, H, max_len, D] cache row per slot, the decoder cache is a shared
+**block pool** [kv_blocks, H, kv_block_size, D] plus a per-row block table
+[capacity, max_blocks] int32 (vLLM's PagedAttention layout, via
+``decode_step_paged`` / ``greedy_step_paged``). A host-side
+:class:`~.blockpool.BlockAllocator` hands blocks to rows as their position
+crosses block boundaries, so KV memory is consumed by tokens actually
+decoded, not by worst-case ``max_len`` reservations. Admission becomes
+**token-budget admission**: a request is admitted while the pool can cover
+its worst-case block need (committed up front, so an admitted request can
+never hit exhaustion mid-flight) — short requests pack densely and pool
+exhaustion surfaces as queue backpressure / OverloadError, never a silent
+clamp. Every device shape stays fixed (tables are [capacity, max_blocks]
+always), so the fused windows, donated-cache dispatch, and batched
+admission all work unchanged; beam cache reordering becomes a host block-
+table swap — shared prefix blocks are refcounted and only the partial tail
+block is physically copied (copy-on-write fork) instead of re-gathering
+the whole cache. With ``max_blocks * kv_block_size == max_len`` (enforced)
+the paged step is bit-identical to the dense one, so all parity contracts
+carry over.
+
+An optional **encoder prefix cache** (``prefix_cache_size > 0``, either
+mode) memoizes encoder outputs by padded source tuple: admissions whose
+source was encoded recently scatter the cached rows instead of re-running
+the encoder (LRU, hit/miss/eviction counters in ServeMetrics).
+
 Search modes per request:
 
 - ``beam_size == 1`` — greedy, one row per request; token choice replicates
@@ -65,7 +91,9 @@ import numpy as np
 
 from ..models.decoding import BOS_ID, EOS_ID, PAD_ID
 from ..obs.trace import span
+from .blockpool import BlockAllocator
 from .metrics import ServeMetrics
+from .prefix import PrefixCache
 from .queue import OverloadError, Request, RequestQueue, RequestState
 
 
@@ -83,6 +111,9 @@ class _Group:
     beam_done: Optional[np.ndarray] = None
     beam_tokens: Optional[np.ndarray] = None
     done: bool = False
+    # Paged mode: worst-case KV blocks reserved for this request at
+    # admission (returned to the pool's commit ledger on release).
+    committed_blocks: int = 0
 
 
 class Engine:
@@ -105,6 +136,9 @@ class Engine:
                  default_max_new_tokens: int = 64,
                  length_penalty: float = 0.6,
                  decode_window: int = 1,
+                 kv_block_size: int = 0,
+                 kv_blocks: int = 0,
+                 prefix_cache_size: int = 0,
                  clock=time.monotonic,
                  metrics: Optional[ServeMetrics] = None):
         if capacity <= 0:
@@ -133,32 +167,99 @@ class Engine:
         self.metrics = metrics if metrics is not None \
             else ServeMetrics(capacity, clock=clock)
 
+        # Paged-KV configuration. The divisibility requirement is what
+        # makes the paged step bit-identical to the dense one: the gathered
+        # span (max_blocks * block_size) must equal max_len so both paths
+        # contract over identical attention shapes.
+        self.kv_block_size = int(kv_block_size)
+        self.paged = self.kv_block_size > 0
+        cap = self.capacity
+        if self.paged:
+            if self.model_max_len % self.kv_block_size:
+                raise ValueError(
+                    f"kv_block_size {self.kv_block_size} must divide the "
+                    f"model max_len {self.model_max_len} (the paged-vs-"
+                    f"dense parity condition)")
+            self.max_blocks_per_row = \
+                self.model_max_len // self.kv_block_size
+            # Default pool: the slot table's KV memory (capacity full
+            # rows) plus the null sentinel block — paged at equal HBM.
+            self.kv_blocks = int(kv_blocks) or \
+                cap * self.max_blocks_per_row + 1
+            self.allocator = BlockAllocator(self.kv_blocks,
+                                            self.kv_block_size)
+            self._block_tables = np.zeros((cap, self.max_blocks_per_row),
+                                          np.int32)
+            self._blocks_bound: List[List[int]] = [[] for _ in range(cap)]
+            self.metrics.configure_kv_pool(self.allocator.usable_blocks,
+                                           self.kv_block_size)
+        else:
+            self.kv_blocks = 0
+            self.max_blocks_per_row = 0
+            self.allocator = None
+            self._block_tables = None
+            self._blocks_bound = None
+        self._prefix = PrefixCache(prefix_cache_size) \
+            if prefix_cache_size > 0 else None
+        if self._prefix is not None:
+            self.metrics.configure_prefix_cache(prefix_cache_size)
+        # Logical source encodes performed (one per admitted request in a
+        # miss/uncached admission) — the number the prefix cache shrinks.
+        self.encoder_invocations = 0
+
         mcls = type(model)
         self._encode_fn = jax.jit(
             lambda v, src, mask: model.apply(v, src, mask,
                                              method=mcls.encode))
 
-        def _step(v, cache, prev, enc, src_mask, pos):
-            logits, mut = model.apply(
-                {**v, "cache": cache}, prev, enc, src_mask, pos,
-                method=mcls.decode_step_at, mutable=["cache"])
-            return logits[:, 0, :].astype(jnp.float32), mut["cache"]
+        nb, bs = self.kv_blocks, self.kv_block_size
+
+        if self.paged:
+            def _step(v, cache, prev, enc, src_mask, pos, tables):
+                logits, mut = model.apply(
+                    {**v, "cache": cache}, prev, enc, src_mask, pos,
+                    tables, num_blocks=nb, block_size=bs,
+                    method=mcls.decode_step_paged, mutable=["cache"])
+                return logits[:, 0, :].astype(jnp.float32), mut["cache"]
+        else:
+            def _step(v, cache, prev, enc, src_mask, pos):
+                logits, mut = model.apply(
+                    {**v, "cache": cache}, prev, enc, src_mask, pos,
+                    method=mcls.decode_step_at, mutable=["cache"])
+                return logits[:, 0, :].astype(jnp.float32), mut["cache"]
 
         # The cache is donated into every decode call: each tick updates
         # it in place (train/trainer.py's donation pattern) instead of
-        # allocating a full copy next to the old one.
+        # allocating a full copy next to the old one. In paged mode the
+        # donated tree is the block pool; the tiny block tables are
+        # re-uploaded per call, never donated.
         self._step_fn = jax.jit(_step, donate_argnums=(1,))
         self._window_fns: Dict[int, Callable] = {}
         self._beam_select_fns: Dict[int, Callable] = {}
 
-        cap = self.capacity
+        if self.paged:
+            def _copy_blocks(cache, dst, src):
+                # Beam-fork tail copy: pool[dst[i]] = pool[src[i]] for the
+                # padded pair list (padding pairs are (0, 0) — a null-
+                # block self-copy no-op). Gathers read the pre-update
+                # pool, so a block freed+reused within one tick still
+                # copies its old content.
+                return jax.tree_util.tree_map(
+                    lambda c: c.at[dst].set(c[src])
+                    if getattr(c, "ndim", 0) == 4 and c.shape[0] == nb
+                    else c, cache)
 
-        def _permute(cache, perm):
-            return jax.tree_util.tree_map(
-                lambda c: c[perm] if getattr(c, "ndim", 0) > 0
-                and c.shape[0] == cap else c, cache)
+            self._copy_blocks_fn = jax.jit(_copy_blocks,
+                                           donate_argnums=(0,))
+            self._permute_fn = None
+        else:
+            def _permute(cache, perm):
+                return jax.tree_util.tree_map(
+                    lambda c: c[perm] if getattr(c, "ndim", 0) > 0
+                    and c.shape[0] == cap else c, cache)
 
-        self._permute_fn = jax.jit(_permute, donate_argnums=(0,))
+            self._permute_fn = jax.jit(_permute, donate_argnums=(0,))
+            self._copy_blocks_fn = None
 
         def _scatter(enc_table, mask_table, enc_new, mask_new, rows):
             # Admission scatter: one donated update for the whole admit
@@ -178,11 +279,21 @@ class Engine:
         dummy_mask = jnp.zeros((cap, s), jnp.int32)
         enc1 = self._encode_fn(variables, dummy_src, dummy_mask)
         self._enc = jnp.zeros((cap, s, enc1.shape[-1]), enc1.dtype)
+        self._enc_dtype = enc1.dtype
+        self._enc_hid = int(enc1.shape[-1])
         self._src_mask = jnp.zeros((cap, s), jnp.int32)
-        self.cache = model.init(
-            jax.random.PRNGKey(0), jnp.zeros((cap, 1), jnp.int32),
-            self._enc, self._src_mask, jnp.zeros((cap,), jnp.int32),
-            method=mcls.decode_step_at)["cache"]
+        if self.paged:
+            self.cache = model.init(
+                jax.random.PRNGKey(0), jnp.zeros((cap, 1), jnp.int32),
+                self._enc, self._src_mask, jnp.zeros((cap,), jnp.int32),
+                jnp.zeros((cap, self.max_blocks_per_row), jnp.int32),
+                num_blocks=nb, block_size=bs,
+                method=mcls.decode_step_paged)["cache"]
+        else:
+            self.cache = model.init(
+                jax.random.PRNGKey(0), jnp.zeros((cap, 1), jnp.int32),
+                self._enc, self._src_mask, jnp.zeros((cap,), jnp.int32),
+                method=mcls.decode_step_at)["cache"]
         # Host-side per-row state (scheduler-authoritative; uploaded into
         # each device call and refreshed from its outputs).
         self._prev = np.full((cap,), PAD_ID, np.int32)
@@ -198,6 +309,8 @@ class Engine:
                request_id: Optional[str] = None) -> Request:
         """Validate + enqueue. Raises OverloadError when the queue is full,
         ValueError on requests the engine could never place."""
+        if not src_ids:
+            raise ValueError("src_ids must be non-empty")
         if len(src_ids) > self.max_src_len:
             raise ValueError(
                 f"source length {len(src_ids)} exceeds the engine's "
@@ -208,6 +321,13 @@ class Engine:
                 f"{self.capacity} — it could never be admitted")
         budget = min(max_new_tokens or self.default_max_new_tokens,
                      self.model_max_len - 1)
+        if self.paged:
+            peak = self._peak_blocks(beam_size, budget)
+            if peak > self.allocator.usable_blocks:
+                raise ValueError(
+                    f"request needs {peak} KV blocks at peak but the pool "
+                    f"only has {self.allocator.usable_blocks} usable — it "
+                    f"could never be admitted")
         try:
             req = self.queue.submit(src_ids, budget, beam_size=beam_size,
                                     deadline_s=deadline_s,
@@ -242,12 +362,92 @@ class Engine:
         return [r for r in range(self.capacity)
                 if self._row_owner[r] is None]
 
+    def _peak_blocks(self, w: int, budget: int) -> int:
+        """Worst-case pool blocks a request can hold at once: every beam
+        row fully extended over the budget, plus (beam only) one transient
+        fresh tail block per row during a copy-on-write fork — the fork
+        allocates the new tails before the old generation's refs drop."""
+        per_row = self.allocator.blocks_for_tokens(budget)
+        return w * per_row + (w if w > 1 else 0)
+
+    def _bind_rows(self, k: int) -> None:
+        """Bind pool blocks to every active row to cover the next ``k``
+        decode steps (called right before each device call). Rows draw
+        from their group's admission-time reservation, so :meth:`alloc`
+        cannot fail here. A done-but-unreleased row inside a window may
+        write one position past its bound span — that lands in the null
+        sentinel block (table entries default 0) and is never attended."""
+        for g in self._groups:
+            span = min(g.steps + k, g.budget)
+            need = min(self.allocator.blocks_for_tokens(span),
+                       self.max_blocks_per_row)
+            for r in g.rows:
+                bound = self._blocks_bound[r]
+                while len(bound) < need:
+                    b = self.allocator.alloc()
+                    self._block_tables[r, len(bound)] = b
+                    bound.append(b)
+
+    def _fork_beam_blocks(self, g: _Group, beam_idx, copy_dst: List[int],
+                          copy_src: List[int]) -> None:
+        """Copy-on-write block fork after a beam reorder. Called when the
+        step that wrote KV position ``s = g.steps`` has executed but
+        ``g.steps`` has not yet advanced. Fully-written prefix blocks are
+        shared by refcount; only a partial tail block is physically copied
+        (the pairs are appended to ``copy_dst``/``copy_src`` and executed
+        in ONE batched donated device call after the group loop — gathers
+        read the pre-update pool, so the pairs are order-independent). A
+        tail that this step just filled to the brim is shared too: the
+        next step starts a fresh block, so it is never rewritten."""
+        s = g.steps
+        bs = self.kv_block_size
+        tail = s // bs
+        tail_full = (s + 1) % bs == 0
+        w = len(g.rows)
+        beam_idx = [int(b) for b in beam_idx]
+        old = {j: list(self._blocks_bound[g.rows[j]]) for j in range(w)}
+        changed = [j for j in range(w) if beam_idx[j] != j]
+        if not changed:
+            return
+        shared_upto = tail + 1 if tail_full else tail
+        new_lists = {}
+        for j in changed:
+            anc = old[beam_idx[j]]
+            new = []
+            for b in anc[:shared_upto]:
+                self.allocator.ref(b)
+                new.append(b)
+            if not tail_full:
+                fresh = self.allocator.alloc()
+                copy_dst.append(fresh)
+                copy_src.append(anc[tail])
+                new.append(fresh)
+            new_lists[j] = new
+        # Refs/allocs above, frees below: a row that is both ancestor and
+        # replaced keeps its blocks alive through the handover.
+        for j in changed:
+            for b in old[j]:
+                self.allocator.free(b)
+        for j in changed:
+            r = g.rows[j]
+            self._blocks_bound[r] = new_lists[j]
+            self._block_tables[r] = 0
+            self._block_tables[r, :len(new_lists[j])] = new_lists[j]
+
     def _release(self, group: _Group, state: RequestState,
                  now: float) -> None:
         for r in group.rows:
             self._row_owner[r] = None
             self._prev[r] = PAD_ID
             self._pos[r] = 0
+            if self.paged:
+                for b in self._blocks_bound[r]:
+                    self.allocator.free(b)
+                self._blocks_bound[r] = []
+                self._block_tables[r] = 0
+        if self.paged:
+            self.allocator.uncommit(group.committed_blocks)
+            group.committed_blocks = 0
         group.req.state = state
         group.req.finished_at = now
         self._groups.remove(group)
@@ -282,8 +482,18 @@ class Engine:
         ``.at[r].set`` copies."""
         free = self._free_rows()
         admits: List[_Group] = []
+        can_place = None
+        if self.paged:
+            # Token-budget admission: the head is admissible only while
+            # the pool can cover its worst-case block reservation. The
+            # predicate reads `free` through the closure, so it tracks
+            # rows handed out earlier in this same admit loop.
+            def can_place(req):
+                return (req.beam_size <= len(free)
+                        and self.allocator.can_commit(self._peak_blocks(
+                            req.beam_size, req.max_new_tokens)))
         while free:
-            req = self.queue.pop_ready(now)
+            req = self.queue.pop_ready(now, can_place=can_place)
             if req is None:
                 break
             w = req.beam_size
@@ -299,6 +509,10 @@ class Engine:
                 self._pos[r] = 0
                 self._row_owner[r] = req.id
             group = _Group(req=req, rows=rows, budget=req.max_new_tokens)
+            if self.paged:
+                peak = self._peak_blocks(w, group.budget)
+                self.allocator.commit(peak)
+                group.committed_blocks = peak
             if w > 1:
                 group.scores = np.full((w,), -1e9, np.float32)
                 group.scores[0] = 0.0
@@ -322,20 +536,61 @@ class Engine:
         cap, s = self.capacity, self.max_src_len
         src = np.full((cap, s), PAD_ID, np.int32)
         row_targets = np.full((cap,), cap, np.int32)
+        group_keys: List[tuple] = []
         j = 0
         for group in admits:
             row_src = np.full((s,), PAD_ID, np.int32)
             row_src[:len(group.req.src_ids)] = group.req.src_ids
+            group_keys.append(tuple(int(t) for t in row_src))
             for r in group.rows:
                 src[j] = row_src
                 row_targets[j] = r
                 j += 1
         mask = (src != PAD_ID).astype(np.int32)
-        enc_new = self._encode_fn(self.variables, jnp.asarray(src),
-                                  jnp.asarray(mask))
+        if self._prefix is None:
+            self.encoder_invocations += len(admits)
+            enc_new = self._encode_fn(self.variables, jnp.asarray(src),
+                                      jnp.asarray(mask))
+            self._enc, self._src_mask = self._admit_scatter_fn(
+                self._enc, self._src_mask, enc_new, jnp.asarray(mask),
+                jnp.asarray(row_targets))
+            return
+        # Prefix-cached prefill: sources are keyed on their padded token
+        # tuple (the exact encoder input, so a hit is bit-identical to
+        # re-encoding). The encoder runs only when at least one admitted
+        # source missed; hit rows take the cached host copy. Both kinds
+        # rejoin the device through the same jitted scatter at the same
+        # shapes, so the cache changes nothing compiled. A source admitted
+        # twice in ONE tick counts as two misses (both encode; the second
+        # put refreshes the entry) — cross-tick repeats are the win.
+        cached_encs = []
+        misses = 0
+        for key in group_keys:
+            cached = self._prefix.get(key)
+            self.metrics.record_prefix(cached is not None)
+            cached_encs.append(cached)
+            if cached is None:
+                misses += 1
+        self.encoder_invocations += misses
+        enc_np = None
+        if misses:
+            enc_dev = self._encode_fn(self.variables, jnp.asarray(src),
+                                      jnp.asarray(mask))
+            enc_np = np.asarray(enc_dev)
+        buffer = np.zeros((cap, s, self._enc_hid), self._enc_dtype)
+        evicted = 0
+        j = 0
+        for group, key, cached in zip(admits, group_keys, cached_encs):
+            if cached is None:
+                cached = enc_np[j].copy()
+                evicted += self._prefix.put(key, cached)
+            for _ in group.rows:
+                buffer[j] = cached
+                j += 1
+        self.metrics.record_prefix_evictions(evicted)
         self._enc, self._src_mask = self._admit_scatter_fn(
-            self._enc, self._src_mask, enc_new, jnp.asarray(mask),
-            jnp.asarray(row_targets))
+            self._enc, self._src_mask, jnp.asarray(buffer),
+            jnp.asarray(mask), jnp.asarray(row_targets))
 
     def _beam_select(self, w: int):
         """Jitted per-group candidate selection — the same f32 log-softmax
@@ -372,13 +627,12 @@ class Engine:
             return fn
         model, mcls = self.model, type(self.model)
         max_len = self.model_max_len
+        nb, bs = self.kv_blocks, self.kv_block_size
 
-        def window(v, cache, prev, pos, steps_left, active, enc, src_mask):
+        def scan_window(apply_step, cache, prev, pos, steps_left, active):
             def body(carry, _):
                 cache, prev, pos, steps_left, active = carry
-                nxt, mut = model.apply(
-                    {**v, "cache": cache}, prev[:, None], enc, src_mask,
-                    pos, method=mcls.greedy_step_at, mutable=["cache"])
+                nxt, mut = apply_step(cache, prev, pos)
                 cache = mut["cache"]
                 token = jnp.where(active, nxt, PAD_ID)
                 steps_left = steps_left - active.astype(jnp.int32)
@@ -397,6 +651,30 @@ class Engine:
             (cache, prev, pos, steps_left, active), (tokens, done_at) = \
                 jax.lax.scan(body, carry, None, length=k)
             return tokens, done_at, prev, pos, active, cache
+
+        if self.paged:
+            def window(v, cache, prev, pos, steps_left, active, enc,
+                       src_mask, tables):
+                # The block tables are bound for the whole window up
+                # front (_bind_rows(k)), so they are loop-invariant.
+                def apply_step(cache, prev, pos):
+                    return model.apply(
+                        {**v, "cache": cache}, prev[:, None], enc,
+                        src_mask, pos, tables, num_blocks=nb,
+                        block_size=bs, method=mcls.greedy_step_paged,
+                        mutable=["cache"])
+                return scan_window(apply_step, cache, prev, pos,
+                                   steps_left, active)
+        else:
+            def window(v, cache, prev, pos, steps_left, active, enc,
+                       src_mask):
+                def apply_step(cache, prev, pos):
+                    return model.apply(
+                        {**v, "cache": cache}, prev[:, None], enc,
+                        src_mask, pos, method=mcls.greedy_step_at,
+                        mutable=["cache"])
+                return scan_window(apply_step, cache, prev, pos,
+                                   steps_left, active)
 
         fn = jax.jit(window, donate_argnums=(1,))
         self._window_fns[k] = fn
@@ -452,11 +730,18 @@ class Engine:
             r = g.rows[0]
             steps_left[r] = g.budget - g.steps
             active[r] = True
+        if self.paged:
+            self._bind_rows(k)
+        # Sampled after binding, before releases: the blocks the device
+        # call actually gathers through, not the post-release residue.
+        kv_in_use = self.allocator.blocks_in_use if self.paged else None
         t0 = self._clock()
-        tokens, done_at, prev, pos, _, self.cache = self._window_fn(k)(
-            self.variables, self.cache, jnp.asarray(self._prev),
-            jnp.asarray(self._pos), jnp.asarray(steps_left),
-            jnp.asarray(active), self._enc, self._src_mask)
+        args = (self.variables, self.cache, jnp.asarray(self._prev),
+                jnp.asarray(self._pos), jnp.asarray(steps_left),
+                jnp.asarray(active), self._enc, self._src_mask)
+        if self.paged:
+            args += (jnp.asarray(self._block_tables),)
+        tokens, done_at, prev, pos, _, self.cache = self._window_fn(k)(*args)
         # The only device→host traffic of the whole window: [K, capacity]
         # int32 tokens + bool done marks and the [capacity] carry vectors.
         tokens = np.asarray(tokens)
@@ -480,8 +765,17 @@ class Engine:
                 if done_at[step_k, r]:
                     self._release(g, RequestState.DONE, now)
                     break
-        self.metrics.record_step(new_tokens, self.queue.depth, new_tokens,
-                                 dt, steps=k)
+        # Occupancy numerator: row·steps of real decode work this window —
+        # each active row counts the steps until it finished (done_at) or
+        # the window closed, NOT rows × k (idle tail steps of finished
+        # rows are padding, not work) and NOT the token count standing in
+        # for it.
+        done_idx = np.where(done_at.any(axis=0),
+                            done_at.argmax(axis=0) + 1, k)
+        active_row_steps = int(done_idx[active].sum())
+        self.metrics.record_step(
+            active_row_steps, self.queue.depth, new_tokens, dt, steps=k,
+            kv_blocks_in_use=kv_in_use)
         return k
 
     def _host_step(self) -> int:
@@ -489,15 +783,23 @@ class Engine:
         selection replicates models/decoding.py on host-visible logits (the
         parity contract); greedy rows sharing the tick ride along exactly
         as they always did."""
+        if self.paged:
+            self._bind_rows(1)
+        kv_in_use = self.allocator.blocks_in_use if self.paged else None
         t0 = self._clock()
-        logits, self.cache = self._step_fn(
-            self.variables, self.cache, jnp.asarray(self._prev[:, None]),
-            self._enc, self._src_mask, jnp.asarray(self._pos))
+        step_args = (self.variables, self.cache,
+                     jnp.asarray(self._prev[:, None]),
+                     self._enc, self._src_mask, jnp.asarray(self._pos))
+        if self.paged:
+            step_args += (jnp.asarray(self._block_tables),)
+        logits, self.cache = self._step_fn(*step_args)
         logits = np.asarray(logits)  # [capacity, V] float32
         rows_active = sum(len(g.rows) for g in self._groups)
         new_tokens = 0
         perm = np.arange(self.capacity)
         perm_needed = False
+        copy_dst: List[int] = []
+        copy_src: List[int] = []
         now = self._clock()
         for g in list(self._groups):
             new_tokens += len(g.rows)
@@ -527,10 +829,17 @@ class Engine:
                 g.beam_tokens[:, g.steps + 1] = tok_idx
                 g.beam_done = g.beam_done[beam_idx] | (tok_idx == EOS_ID)
                 if not np.array_equal(beam_idx, np.arange(w)):
-                    # Surviving beams inherit their ancestor's cache rows.
-                    for j in range(w):
-                        perm[g.rows[j]] = g.rows[beam_idx[j]]
-                    perm_needed = True
+                    # Surviving beams inherit their ancestor's cache: a
+                    # whole-row permutation in slot mode, a copy-on-write
+                    # block-table fork in paged mode (shared prefix blocks
+                    # gain a ref; only a partial tail block is copied).
+                    if self.paged:
+                        self._fork_beam_blocks(g, beam_idx, copy_dst,
+                                               copy_src)
+                    else:
+                        for j in range(w):
+                            perm[g.rows[j]] = g.rows[beam_idx[j]]
+                        perm_needed = True
                 exhausted = False
                 for j, r in enumerate(g.rows):
                     self._prev[r] = int(tok_idx[j])
@@ -550,8 +859,22 @@ class Engine:
                     self._release(g, RequestState.DONE, now)
         if perm_needed:
             self.cache = self._permute_fn(self.cache, jnp.asarray(perm))
-        self.metrics.record_step(rows_active, self.queue.depth, new_tokens,
-                                 self._clock() - t0)
+        if copy_dst:
+            # One batched donated copy for every fork this tick, padded to
+            # [capacity] with (0, 0) null-block self-copies so the call
+            # compiles once. Gathers read the pre-update pool, so a block
+            # freed and re-handed-out within this tick still sources its
+            # old content; dst blocks are freshly allocated, hence
+            # globally unique across groups.
+            dst = np.zeros((self.capacity,), np.int32)
+            srcb = np.zeros((self.capacity,), np.int32)
+            dst[:len(copy_dst)] = copy_dst
+            srcb[:len(copy_src)] = copy_src
+            self.cache = self._copy_blocks_fn(self.cache, jnp.asarray(dst),
+                                              jnp.asarray(srcb))
+        self.metrics.record_step(
+            rows_active, self.queue.depth, new_tokens, self._clock() - t0,
+            kv_blocks_in_use=kv_in_use)
         return 1
 
     def run_until_drained(self, max_steps: int = 1_000_000,

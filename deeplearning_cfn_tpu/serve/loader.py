@@ -26,6 +26,8 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
                 default_max_new_tokens: int = 64,
                 length_penalty: Optional[float] = None,
                 decode_window: int = 1,
+                kv_block_size: int = 0, kv_blocks: int = 0,
+                prefix_cache_size: int = 0,
                 step: int = 0, vocab: str = "", allow_init: bool = False,
                 clock=time.monotonic) -> Tuple[Engine, object, int]:
     """Build an Engine from a trained experiment.
@@ -76,6 +78,8 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
         length_penalty=cfg.eval.length_penalty
         if length_penalty is None else length_penalty,
         decode_window=decode_window,
+        kv_block_size=kv_block_size, kv_blocks=kv_blocks,
+        prefix_cache_size=prefix_cache_size,
         clock=clock)
     engine.metrics.ckpt_load_retries = manager.store_retries()
     return engine, bpe, int(at_step)

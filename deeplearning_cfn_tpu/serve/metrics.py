@@ -75,6 +75,48 @@ class ServeMetrics:
                                        "submit to admit")
         self._step_latency = r.histogram("serve_step_latency_s",
                                          "per decode step device time")
+        # Optional surfaces — instruments (and snapshot keys) exist only
+        # once the engine configures the feature, so slot-table engines
+        # keep emitting byte-identical records (the exact-key snapshot
+        # contract in tests/test_obs.py).
+        self._kv_pool = None  # (usable_blocks, block_size) when paged
+        self._kv_in_use = None
+        self._kv_util_sum = None
+        self._kv_util_calls = None
+        self._prefix_size = 0
+        self._prefix_lookups = None
+        self._prefix_evictions = None
+
+    # -- optional feature surfaces -----------------------------------------
+
+    def configure_kv_pool(self, usable_blocks: int, block_size: int) -> None:
+        """Enable the paged-KV metric surface (serve_kv_block_*)."""
+        r = self.registry
+        self._kv_pool = (usable_blocks, block_size)
+        self._kv_in_use = r.gauge(
+            "serve_kv_blocks_in_use", "allocated KV pool blocks")
+        self._kv_util_sum = r.counter(
+            "serve_kv_block_util_sum",
+            "sum of per-device-call pool utilization fractions")
+        self._kv_util_calls = r.counter(
+            "serve_kv_block_util_calls", "device calls with pool readings")
+
+    def configure_prefix_cache(self, max_entries: int) -> None:
+        """Enable the encoder-prefix-cache metric surface (serve_prefix_*)."""
+        r = self.registry
+        self._prefix_size = max_entries
+        self._prefix_lookups = r.counter(
+            "serve_prefix_lookups_total", "prefix cache lookups by result")
+        self._prefix_evictions = r.counter(
+            "serve_prefix_evictions_total", "prefix cache LRU evictions")
+
+    def record_prefix(self, hit: bool) -> None:
+        if self._prefix_lookups is not None:
+            self._prefix_lookups.inc(result="hit" if hit else "miss")
+
+    def record_prefix_evictions(self, n: int) -> None:
+        if self._prefix_evictions is not None and n:
+            self._prefix_evictions.inc(n)
 
     # -- recording hooks (called by the engine) ----------------------------
 
@@ -103,12 +145,15 @@ class ServeMetrics:
 
     def record_step(self, active_rows: float, queue_depth: int,
                     new_tokens: int, step_time_s: float,
-                    steps: int = 1) -> None:
+                    steps: int = 1,
+                    kv_blocks_in_use: Optional[int] = None) -> None:
         """One device call covering ``steps`` decode steps.
 
         ``active_rows`` is the total active row-steps across the call
         (for a single step, simply the active row count), so occupancy
         stays an average over decode steps whatever the window size.
+        ``kv_blocks_in_use`` is the paged engine's pool occupancy at the
+        call (only meaningful after :meth:`configure_kv_pool`).
         """
         steps = max(int(steps), 1)
         self._steps.inc(steps)
@@ -118,6 +163,11 @@ class ServeMetrics:
         self._occupancy_sum_c.inc(active_rows / max(self.capacity, 1))
         self._step_latency.observe(step_time_s / steps)
         self._queue_depth.set(queue_depth)
+        if kv_blocks_in_use is not None and self._kv_pool is not None:
+            self._kv_in_use.set(kv_blocks_in_use)
+            self._kv_util_sum.inc(
+                kv_blocks_in_use / max(self._kv_pool[0], 1))
+            self._kv_util_calls.inc()
 
     # -- pre-registry attribute surface (properties over instruments) ------
 
@@ -218,8 +268,37 @@ class ServeMetrics:
             return None
         return self.steps / windows
 
+    @property
+    def kv_block_utilization(self) -> Optional[float]:
+        """Mean allocated-pool fraction over device calls (paged only)."""
+        if self._kv_util_calls is None:
+            return None
+        calls = self._kv_util_calls.value()
+        if calls == 0:
+            return None
+        return self._kv_util_sum.value() / calls
+
+    @property
+    def prefix_hits(self) -> int:
+        if self._prefix_lookups is None:
+            return 0
+        return int(self._prefix_lookups.value(result="hit"))
+
+    @property
+    def prefix_misses(self) -> int:
+        if self._prefix_lookups is None:
+            return 0
+        return int(self._prefix_lookups.value(result="miss"))
+
+    @property
+    def prefix_hit_rate(self) -> Optional[float]:
+        lookups = self.prefix_hits + self.prefix_misses
+        if lookups == 0:
+            return None
+        return self.prefix_hits / lookups
+
     def snapshot(self) -> Dict:
-        return {
+        snap = {
             "serve_submitted": self.submitted,
             "serve_rejected": self.rejected,
             "serve_admitted": self.admitted,
@@ -246,6 +325,25 @@ class ServeMetrics:
             "serve_step_latency_p95_s": self._step_latency.percentile(95),
             "serve_uptime_s": self._clock() - self.started_at,
         }
+        # Feature-gated keys: present only when the engine configured the
+        # paged pool / prefix cache, so the base snapshot surface (and the
+        # exact-key parity tests over it) is untouched for slot engines.
+        if self._kv_pool is not None:
+            usable, block_size = self._kv_pool
+            in_use = self._kv_in_use.value()
+            snap["serve_kv_blocks_total"] = usable
+            snap["serve_kv_block_size"] = block_size
+            snap["serve_kv_blocks_in_use"] = \
+                int(in_use) if in_use is not None else 0
+            snap["serve_kv_block_utilization"] = self.kv_block_utilization
+        if self._prefix_size:
+            snap["serve_prefix_cache_size"] = self._prefix_size
+            snap["serve_prefix_hits"] = self.prefix_hits
+            snap["serve_prefix_misses"] = self.prefix_misses
+            snap["serve_prefix_evictions"] = \
+                int(self._prefix_evictions.value())
+            snap["serve_prefix_hit_rate"] = self.prefix_hit_rate
+        return snap
 
     def emit(self, writer: MetricsWriter, **extra) -> None:
         writer.write({**self.snapshot(), **extra})

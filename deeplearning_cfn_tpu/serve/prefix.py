@@ -1,0 +1,68 @@
+"""Encoder prefix cache: skip re-encoding sources seen recently.
+
+NMT serving traffic repeats sources (retries, fan-out to multiple decode
+configs, popular sentences), and the engine re-ran the full encoder stack
+for every admission. This is a small host-side LRU over encoder outputs,
+keyed on the **padded source-token tuple** — the exact array the encoder
+would see, so a hit is bit-identical to re-encoding (encoder padding
+invariance already guarantees the value doesn't depend on batch
+neighbours; see docs/SERVING.md).
+
+Values are host numpy arrays ([S, H] encoder output rows) — they rejoin
+the device through the same jitted admission scatter the miss path uses,
+so enabling the cache changes no compiled shapes. The engine owns the
+metrics mirror (ServeMetrics ``serve_prefix_*``); this class just counts.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Hashable, Optional
+
+
+class PrefixCache:
+    """Bounded LRU of encoder outputs, keyed on padded source tuples."""
+
+    def __init__(self, max_entries: int):
+        if max_entries <= 0:
+            raise ValueError(
+                f"max_entries must be positive, got {max_entries}")
+        self.max_entries = max_entries
+        self._entries: "collections.OrderedDict" = collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: Hashable) -> bool:
+        return key in self._entries
+
+    def get(self, key: Hashable):
+        """Cached value or None; counts the lookup and refreshes LRU."""
+        entry = self._entries.get(key)
+        if entry is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        return entry
+
+    def put(self, key: Hashable, value) -> int:
+        """Insert (or refresh) an entry; returns how many were evicted."""
+        self._entries[key] = value
+        self._entries.move_to_end(key)
+        evicted = 0
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+            evicted += 1
+        self.evictions += evicted
+        return evicted
+
+    @property
+    def hit_rate(self) -> Optional[float]:
+        lookups = self.hits + self.misses
+        if lookups == 0:
+            return None
+        return self.hits / lookups

@@ -165,22 +165,34 @@ class RequestQueue:
             self._by_id[rid] = req
             return req
 
-    def pop_ready(self, now: Optional[float] = None) -> Optional[Request]:
+    def pop_ready(self, now: Optional[float] = None,
+                  can_place=None) -> Optional[Request]:
         """Next admissible request (FIFO), skipping — and finalizing —
         requests that were cancelled or expired while queued. Returns None
-        when nothing is admissible."""
+        when nothing is admissible.
+
+        ``can_place`` is an optional predicate the engine uses for
+        capacity-aware admission (free rows, KV block budget): the head is
+        PEEKED first and only popped if placeable. A non-placeable head
+        returns None without popping — FIFO is preserved, a large request
+        blocks later ones rather than being starved by them."""
         now = self._clock() if now is None else now
         with self._lock:
             while self._pending:
-                req = self._pending.pop(0)
+                req = self._pending[0]
                 if req.cancel_requested:
+                    self._pending.pop(0)
                     req.state = RequestState.CANCELLED
                     req.finished_at = now
                     continue
                 if req.deadline is not None and now >= req.deadline:
+                    self._pending.pop(0)
                     req.state = RequestState.EXPIRED
                     req.finished_at = now
                     continue
+                if can_place is not None and not can_place(req):
+                    return None
+                self._pending.pop(0)
                 self._recent_waits.append(now - req.submitted_at)
                 return req
             return None

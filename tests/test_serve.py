@@ -19,8 +19,11 @@ from deeplearning_cfn_tpu.data.bpe import NMT_SPECIALS, train_bpe
 from deeplearning_cfn_tpu.models import decoding
 from deeplearning_cfn_tpu.models.transformer_nmt import transformer_nmt_tiny
 from deeplearning_cfn_tpu.serve import (
+    BlockAllocator,
+    BlockPoolExhausted,
     Engine,
     OverloadError,
+    PrefixCache,
     RequestQueue,
     RequestState,
     ServeMetrics,
@@ -112,6 +115,19 @@ def test_queue_rejects_bad_requests():
         q.submit([5, 2], 4, request_id="dup")
 
 
+def test_pop_ready_can_place_keeps_fifo():
+    """A non-placeable head parks the queue: pop_ready returns None
+    WITHOUT popping, so a big request is never starved by smaller ones
+    sneaking past it."""
+    q = RequestQueue(max_depth=4)
+    big = q.submit([5, 2], 8, beam_size=4)
+    small = q.submit([6, 2], 8)
+    assert q.pop_ready(can_place=lambda r: r.beam_size == 1) is None
+    assert q.depth == 2  # nothing popped, nothing reordered
+    assert q.pop_ready(can_place=lambda r: True) is big
+    assert q.pop_ready(can_place=lambda r: True) is small
+
+
 def test_queued_cancel_and_deadline_finalize_at_pop():
     clock = FakeClock()
     q = RequestQueue(max_depth=4, clock=clock)
@@ -189,6 +205,80 @@ def test_serve_metrics_queue_wait_and_window_accounting():
     assert snap["serve_slot_occupancy"] == pytest.approx(0.5)
     assert snap["serve_step_latency_p50_s"] == pytest.approx(0.05)
     assert snap["serve_tokens_per_sec"] == pytest.approx(40.0)
+
+
+# -- KV block allocator -----------------------------------------------------
+
+
+def test_block_allocator_alloc_free_reuse():
+    a = BlockAllocator(num_blocks=5, block_size=4)
+    assert a.usable_blocks == 4 and a.free_blocks == 4
+    b1, b2 = a.alloc(), a.alloc()
+    assert 0 not in (b1, b2), "null sentinel must never be handed out"
+    assert a.blocks_in_use == 2 and a.is_allocated(b1)
+    a.free(b1)
+    assert not a.is_allocated(b1) and a.free_blocks == 3
+    b3 = a.alloc()  # freed blocks return to the pool
+    assert a.blocks_in_use == 2
+    for b in (b2, b3):
+        a.free(b)
+    assert a.free_blocks == 4
+    with pytest.raises(ValueError):
+        a.free(b3)  # double free
+    assert a.blocks_for_tokens(1) == 1
+    assert a.blocks_for_tokens(4) == 1
+    assert a.blocks_for_tokens(5) == 2
+
+
+def test_block_allocator_refcounted_sharing():
+    """Beam prefix sharing: a block freed by one row survives while a
+    sibling still references it."""
+    a = BlockAllocator(num_blocks=4, block_size=2)
+    b = a.alloc()
+    a.ref(b)
+    assert a.refcount(b) == 2
+    a.free(b)
+    assert a.is_allocated(b), "one ref left — must stay allocated"
+    a.free(b)
+    assert not a.is_allocated(b)
+    with pytest.raises(ValueError):
+        a.ref(b)  # ref on a returned block is a bug, not a revival
+
+
+def test_block_allocator_exhaustion_is_overload():
+    """Pool exhaustion is backpressure, not a crash or a silent clamp:
+    BlockPoolExhausted IS an OverloadError, raised by both the admission
+    ledger (commit) and a bare alloc on an empty free list."""
+    a = BlockAllocator(num_blocks=3, block_size=4)
+    a.commit(2)
+    assert not a.can_commit(1)
+    with pytest.raises(BlockPoolExhausted) as ei:
+        a.commit(1)
+    assert isinstance(ei.value, OverloadError)
+    a.uncommit(2)
+    with pytest.raises(ValueError):
+        a.uncommit(1)  # over-uncommit is a ledger bug
+    a.alloc(), a.alloc()
+    with pytest.raises(BlockPoolExhausted):
+        a.alloc()
+
+
+# -- encoder prefix cache ---------------------------------------------------
+
+
+def test_prefix_cache_hit_miss_and_lru_eviction():
+    c = PrefixCache(max_entries=2)
+    assert c.get(("a",)) is None and c.misses == 1
+    assert c.put(("a",), 1) == 0
+    assert c.put(("b",), 2) == 0
+    assert c.get(("a",)) == 1 and c.hits == 1  # refreshes "a"
+    assert c.put(("c",), 3) == 1  # evicts "b", the least recent
+    assert ("b",) not in c and ("a",) in c and ("c",) in c
+    assert c.evictions == 1
+    assert c.get(("b",)) is None
+    assert c.hit_rate == pytest.approx(1 / 3)
+    with pytest.raises(ValueError):
+        PrefixCache(0)
 
 
 # -- engine: shared tiny model ----------------------------------------------
@@ -559,6 +649,283 @@ def test_windowed_slot_churn_keeps_invariants(sched_model):
     assert all(eng.poll(s.id).state is RequestState.DONE for s in shorts)
 
 
+# -- engine: paged KV cache -------------------------------------------------
+
+
+def test_engine_submit_rejects_empty_src(sched_model):
+    eng = _mk_engine(sched_model)
+    with pytest.raises(ValueError):
+        eng.submit([], max_new_tokens=2)
+
+
+def test_paged_engine_validates_block_size(sched_model):
+    with pytest.raises(ValueError):
+        _mk_engine(sched_model, kv_block_size=5)  # 5 does not divide 32
+
+
+def test_paged_submit_rejects_never_placeable(sched_model):
+    """A request whose worst-case block need exceeds the whole pool is
+    rejected at submit — it could never be admitted."""
+    eng = _mk_engine(sched_model, kv_block_size=4, kv_blocks=3)
+    with pytest.raises(ValueError):
+        eng.submit(_src(1), max_new_tokens=12)  # 3 blocks > 2 usable
+
+
+@pytest.mark.parametrize("window", [1, 4])
+def test_paged_greedy_parity(parity_setup, window):
+    """Paged attention is a memory-layout change, token-identical to the
+    dense slot engine AND the offline greedy searcher at every window."""
+    model, variables, srcs = parity_setup
+    direct = [_direct_decode(model, variables, s, 1) for s in srcs]
+    dense = Engine(model, variables, capacity=2,
+                   max_src_len=PARITY_SRC_LEN,
+                   default_max_new_tokens=PARITY_NEW_TOKENS,
+                   decode_window=window)
+    paged = Engine(model, variables, capacity=2,
+                   max_src_len=PARITY_SRC_LEN,
+                   default_max_new_tokens=PARITY_NEW_TOKENS,
+                   decode_window=window, kv_block_size=4)
+    outs = []
+    for eng in (dense, paged):
+        reqs = [eng.submit(s) for s in srcs]
+        eng.run_until_drained()
+        outs.append([decoding.strip_special(eng.poll(r.id).tokens)
+                     for r in reqs])
+    assert outs[0] == direct
+    assert outs[1] == direct
+
+
+@pytest.mark.parametrize("window", [1, 4])
+def test_paged_beam_parity(parity_setup, window):
+    """Beam groups on the paged cache — copy-on-write block forks instead
+    of whole-row permutation — reproduce beam_decode_cached exactly."""
+    model, variables, srcs = parity_setup
+    direct = [_direct_decode(model, variables, s, 2) for s in srcs]
+    eng = Engine(model, variables, capacity=4, max_src_len=PARITY_SRC_LEN,
+                 default_max_new_tokens=PARITY_NEW_TOKENS,
+                 decode_window=window, kv_block_size=4)
+    reqs = [eng.submit(s, beam_size=2) for s in srcs]
+    eng.run_until_drained()
+    got = [decoding.strip_special(eng.poll(r.id).tokens) for r in reqs]
+    assert got == direct
+
+
+def test_paged_mixed_traffic_parity(parity_setup):
+    model, variables, srcs = parity_setup
+    eng = Engine(model, variables, capacity=3, max_src_len=PARITY_SRC_LEN,
+                 default_max_new_tokens=PARITY_NEW_TOKENS,
+                 decode_window=4, kv_block_size=8, prefix_cache_size=4)
+    reqs = [eng.submit(s, beam_size=1 + (i % 2))
+            for i, s in enumerate(srcs)]
+    eng.run_until_drained()
+    for i, (r, s) in enumerate(zip(reqs, srcs)):
+        want = _direct_decode(model, variables, s, 1 + (i % 2))
+        assert decoding.strip_special(eng.poll(r.id).tokens) == want
+
+
+def test_paged_greedy_path_never_materializes_logits(sched_model):
+    """The paged fast path keeps the no-logits contract: all-greedy
+    traffic never invokes the logits-returning step."""
+    for window in (1, 4):
+        eng = _mk_engine(sched_model, capacity=2, queue_depth=16,
+                         decode_window=window, kv_block_size=4)
+
+        def _boom(*a, **k):
+            raise AssertionError("logits step ran on an all-greedy trace")
+
+        eng._step_fn = _boom
+        reqs = [eng.submit(_src(i), max_new_tokens=3) for i in range(5)]
+        eng.run_until_drained()
+        assert all(eng.poll(r.id).state is RequestState.DONE for r in reqs)
+
+
+def test_paged_cache_is_donated_into_the_step(sched_model):
+    """Donation survives paging: the block pool is consumed by each decode
+    call, not copied beside itself."""
+    eng = _mk_engine(sched_model, capacity=2, decode_window=2,
+                     kv_block_size=4)
+    eng.submit(_src(1), max_new_tokens=6)
+    eng.step()
+    stale = jax.tree_util.tree_leaves(eng.cache)
+    eng.step()
+    assert any(l.is_deleted() for l in stale if getattr(l, "ndim", 0) >= 4)
+    eng.run_until_drained()
+    assert all(not l.is_deleted() for l in
+               jax.tree_util.tree_leaves(eng.cache))
+
+
+def test_paged_block_accounting_under_churn(sched_model):
+    """Allocator/table invariants across constant turnover with mixed
+    greedy+beam traffic: every nonzero table entry is a live block, a
+    greedy row's blocks are exclusively its own, and a drained engine
+    returns every block and every commitment."""
+    eng = _mk_engine(sched_model, capacity=3, queue_depth=32,
+                     decode_window=4, kv_block_size=4)
+    reqs = [eng.submit(_src(i), max_new_tokens=2 + i % 5,
+                       beam_size=1 + (i % 3 == 0))
+            for i in range(10)]
+    steps = 0
+    while eng.queue.depth > 0 or eng.active_requests:
+        eng.step()
+        steps += 1
+        alloc = eng.allocator
+        for g in eng._groups:
+            for r in g.rows:
+                bound = eng._blocks_bound[r]
+                table = eng._block_tables[r]
+                assert list(table[:len(bound)]) == bound
+                assert (table[len(bound):] == 0).all()
+                for b in bound:
+                    assert alloc.is_allocated(b), "row reads a freed block"
+                if g.req.beam_size == 1:
+                    assert all(alloc.refcount(b) == 1 for b in bound)
+        assert alloc.blocks_in_use <= alloc.usable_blocks
+        assert alloc.committed_blocks <= alloc.usable_blocks
+        assert steps < 300
+    assert all(eng.poll(r.id).state is RequestState.DONE for r in reqs)
+    assert eng.allocator.blocks_in_use == 0
+    assert eng.allocator.committed_blocks == 0
+
+
+def test_paged_token_budget_admission_defers_not_clamps(sched_model):
+    """When the pool cannot cover a request's token budget, the request
+    WAITS (and runs with its full budget later) — admission control, never
+    a silent budget clamp."""
+    eng = _mk_engine(sched_model, capacity=2, kv_block_size=4, kv_blocks=3)
+    a = eng.submit(_src(1), max_new_tokens=8)  # 2 blocks = whole pool
+    b = eng.submit(_src(2), max_new_tokens=8)
+    eng.step()
+    assert eng.poll(a.id).state is RequestState.RUNNING
+    assert eng.poll(b.id).state is RequestState.QUEUED, \
+        "pool is fully committed — b must wait despite a free row"
+    eng.run_until_drained()
+    assert eng.poll(a.id).state is RequestState.DONE
+    assert eng.poll(b.id).state is RequestState.DONE
+    # Full-budget outputs, identical to an engine with a roomy pool — the
+    # tight pool delayed b, it did not shrink it.
+    roomy = _mk_engine(sched_model, capacity=2, kv_block_size=4)
+    ra = roomy.submit(_src(1), max_new_tokens=8)
+    rb = roomy.submit(_src(2), max_new_tokens=8)
+    roomy.run_until_drained()
+    assert eng.poll(a.id).tokens == roomy.poll(ra.id).tokens
+    assert eng.poll(b.id).tokens == roomy.poll(rb.id).tokens
+
+
+def test_paged_coresidency_beats_dense_at_equal_memory(sched_model):
+    """The headline win: at the SAME KV memory (dense capacity x max_len
+    = pool blocks x block size), short-budget traffic co-resides >= 1.5x
+    more requests on the paged engine."""
+    model, _ = sched_model
+
+    def peak_coresident(**kw):
+        eng = _mk_engine(sched_model, queue_depth=64, **kw)
+        for i in range(12):
+            eng.submit(_src(30 + i), max_new_tokens=3)
+        peak, steps = 0, 0
+        while eng.queue.depth > 0 or eng.active_requests:
+            eng.step()
+            peak = max(peak, eng.active_requests)
+            steps += 1
+            assert steps < 300
+        return peak
+
+    dense_peak = peak_coresident(capacity=4)
+    # Equal KV memory: 4 rows x 32 positions = 128 positions = 32 blocks
+    # of 4 (+1 null). The paged engine spends it on 8 slim rows instead.
+    paged_peak = peak_coresident(capacity=8, kv_block_size=4, kv_blocks=33)
+    assert dense_peak <= 4
+    assert paged_peak >= 1.5 * dense_peak
+
+
+def test_paged_prefix_cache_reuses_encoder_outputs(sched_model):
+    """Repeated sources hit the prefix cache (fewer logical encodes than
+    admissions) and hit requests decode the exact same tokens as a cold
+    engine."""
+    cold = _mk_engine(sched_model, capacity=2, queue_depth=16)
+    eng = _mk_engine(sched_model, capacity=2, queue_depth=16,
+                     kv_block_size=4, prefix_cache_size=8)
+    srcs = [_src(1), _src(2), _src(1), _src(2), _src(1)]
+    outs = {}
+    for e in (cold, eng):
+        reqs = [e.submit(s, max_new_tokens=4) for s in srcs]
+        e.run_until_drained()
+        outs[e] = [e.poll(r.id).tokens for r in reqs]
+    assert outs[cold] == outs[eng]
+    assert eng.metrics.prefix_hits >= 2
+    assert eng.encoder_invocations < eng.metrics.admitted
+    assert eng.metrics.prefix_hit_rate > 0
+    snap = eng.metrics.snapshot()
+    assert snap["serve_prefix_hits"] == eng.metrics.prefix_hits
+    assert snap["serve_kv_blocks_total"] == eng.allocator.usable_blocks
+
+
+def test_prefix_cache_eviction_keeps_correctness(sched_model):
+    """A 1-entry cache under alternating sources evicts constantly and
+    must still be output-identical to the uncached engine."""
+    cold = _mk_engine(sched_model, capacity=1, queue_depth=16)
+    eng = _mk_engine(sched_model, capacity=1, queue_depth=16,
+                     prefix_cache_size=1)
+    srcs = [_src(1), _src(2), _src(1), _src(2)]
+    outs = {}
+    for e in (cold, eng):
+        reqs = [e.submit(s, max_new_tokens=4) for s in srcs]
+        e.run_until_drained()
+        outs[e] = [e.poll(r.id).tokens for r in reqs]
+    assert outs[cold] == outs[eng]
+    assert eng.metrics.snapshot()["serve_prefix_evictions"] >= 1
+
+
+def test_fused_window_records_active_row_steps(sched_model):
+    """record_step's occupancy numerator is row-steps of real decode work
+    (each row counted until it finished), derived from the window's done
+    mask — not rows x window and not a token-count stand-in."""
+    eng = _mk_engine(sched_model, capacity=4, decode_window=4)
+    calls = []
+    real = eng.metrics.record_step
+
+    def spy(active_rows, queue_depth, new_tokens, dt, **kw):
+        calls.append((active_rows, new_tokens, kw.get("steps", 1)))
+        return real(active_rows, queue_depth, new_tokens, dt, **kw)
+
+    eng.metrics.record_step = spy
+    reqs = [eng.submit(_src(i), max_new_tokens=2) for i in range(2)]
+    eng.step()
+    assert all(eng.poll(r.id).state is RequestState.DONE for r in reqs)
+    (active_row_steps, new_tokens, steps), = calls
+    assert steps == 4
+    # 2 rows, each active for exactly its 2-token budget inside the
+    # 4-step window: 4 row-steps, NOT 2 rows x 4 steps = 8.
+    assert active_row_steps == 4
+    assert new_tokens == 4
+    # Occupancy: 4 row-steps over a 4-step window on 4 slots = 0.25.
+    assert eng.metrics.mean_slot_occupancy == pytest.approx(0.25)
+
+
+def test_serve_metrics_paged_keys_are_conditional():
+    """An unconfigured ServeMetrics snapshot has NO paged/prefix keys (the
+    pinned obs contract); configuring the surfaces adds them."""
+    base = ServeMetrics(capacity=2, clock=FakeClock())
+    snap = base.snapshot()
+    assert not any(k.startswith(("serve_kv_", "serve_prefix_"))
+                   for k in snap)
+    m = ServeMetrics(capacity=2, clock=FakeClock())
+    m.configure_kv_pool(usable_blocks=8, block_size=4)
+    m.configure_prefix_cache(max_entries=16)
+    m.record_prefix(True)
+    m.record_prefix(False)
+    m.record_step(2, 0, 2, 0.1, kv_blocks_in_use=4)
+    snap = m.snapshot()
+    assert snap["serve_kv_blocks_total"] == 8
+    assert snap["serve_kv_block_size"] == 4
+    assert snap["serve_kv_blocks_in_use"] == 4
+    assert snap["serve_kv_block_utilization"] == pytest.approx(0.5)
+    assert snap["serve_prefix_cache_size"] == 16
+    assert snap["serve_prefix_hits"] == 1
+    assert snap["serve_prefix_misses"] == 1
+    assert snap["serve_prefix_hit_rate"] == pytest.approx(0.5)
+    assert snap["serve_prefix_evictions"] == 0
+
+
 # -- CLI + bench ------------------------------------------------------------
 
 CLI_OVERRIDES = [
@@ -665,6 +1032,26 @@ def test_serve_bench_record_contract():
     assert rec["step_latency_p50_s"] is not None
     assert rec["step_latency_p95_s"] is not None
     assert rec["queue_wait_p50_s"] is not None
+    # Paged-cache + prefix diagnostics joined the record contract.
+    assert rec["kv_block_size"] == 16
+    assert rec["kv_blocks"] > 0
+    assert rec["kv_block_utilization"] is not None
+    assert rec["encoder_invocations"] > 0
+    assert rec["admitted"] > 0
+
+
+def test_serve_bench_prefix_dup_exercises_the_cache():
+    """`--prefix-dup 0.5`-style traces must show real prefix reuse: a
+    positive hit rate and fewer logical encoder invocations than
+    admissions."""
+    from deeplearning_cfn_tpu.serve.bench import run_serve_bench
+
+    rec = run_serve_bench(num_requests=8, slots=2, max_new_tokens=4,
+                          src_len=8, prefix_dup=0.6)
+    assert rec["prefix_dup"] == 0.6
+    assert rec["prefix_hit_rate"] is not None
+    assert rec["prefix_hit_rate"] > 0
+    assert rec["encoder_invocations"] < rec["admitted"]
 
 
 def test_cli_bench_serve_smoke_emits_contract_record(capsys):
