@@ -40,6 +40,14 @@ Env overrides the driver (or an operator) can set:
   DLCFN_BENCH_GLOBAL_BATCH, DLCFN_BENCH_TOTAL_BUDGET_S,
   DLCFN_BENCH_ATTEMPT_RESERVE_S (kept back for attempt 2).
 
+Regression gate: when DLCFN_BENCH_DIFF_AGAINST points at a prior contract
+record (JSON file, or a JSONL whose last record wins), the green record is
+compared against it with obs/diff.py's direction-aware comparator
+(value/mfu regress when they fall, mean_step_s when it rises; tolerance
+DLCFN_BENCH_DIFF_TOLERANCE, default 0.10) and carries the verdict in
+"regression_gate". The gate annotates — it never flips the exit code or
+nulls a measured value; unmeasured records are never compared.
+
 vs_baseline: the reference repo publishes no numbers (BASELINE.json
 "published": {}), so the ratio is computed against the external context
 anchor recorded in BASELINE.md — TF+Horovod ResNet-50 at ~375 images/sec per
@@ -196,6 +204,34 @@ def _finalize_green(record: dict, alive: bool, probe_note: str,
     return record
 
 
+def _apply_diff_gate(record: dict) -> dict:
+    """Regression-gate a green record against DLCFN_BENCH_DIFF_AGAINST
+    (see module docstring). Purely additive: any failure inside the gate
+    is recorded and the contract line still ships."""
+    prior_path = os.environ.get("DLCFN_BENCH_DIFF_AGAINST")
+    if not prior_path:
+        return record
+    tol = float(os.environ.get("DLCFN_BENCH_DIFF_TOLERANCE", "0.10"))
+    try:
+        sys.path.insert(0, REPO_ROOT)
+        from deeplearning_cfn_tpu.obs.diff import (
+            diff_bench_records, load_bench_record)
+
+        prior = load_bench_record(prior_path)
+        if prior is None:
+            record["regression_gate"] = {
+                "against": prior_path, "ok": True,
+                "skipped": "no parseable prior record"}
+        else:
+            gate = diff_bench_records(prior, record, tolerance=tol)
+            gate["against"] = prior_path
+            record["regression_gate"] = gate
+    except Exception as e:  # never let the gate eat the contract line
+        record["regression_gate"] = {"against": prior_path, "ok": True,
+                                     "error": str(e)[:500]}
+    return record
+
+
 def _artifact_path() -> str:
     # Overridable so tests exercising the wrapper don't litter the repo's
     # committed evidence directory with fake-run logs.
@@ -285,6 +321,7 @@ def main() -> None:
         record = _parse_record(proc.stdout)
         if proc.returncode == 0 and record is not None:
             record = _finalize_green(record, alive, probe_note, forced_cpu)
+            record = _apply_diff_gate(record)
             record["artifact"] = rel_artifact
             _log(f"==== {'GREEN' if record['measured'] else 'RED'}: "
                  f"{json.dumps(record)} ====")

@@ -8,6 +8,8 @@ case of this harness for the driver contract.
 
 from __future__ import annotations
 
+import json
+import os
 import sys
 import time
 from typing import Dict, Optional
@@ -501,7 +503,8 @@ def run_obs_overhead_smoke(
     # instrumented cost (id alloc, record build, sink write) — then the
     # process default is restored.
     tracer = Tracer()
-    tracer.add_sink(MemorySink())
+    sink = MemorySink()
+    tracer.add_sink(sink)
     configured(tracer)
     try:
         stage("timed_obs_off", steps=steps)
@@ -524,6 +527,31 @@ def run_obs_overhead_smoke(
         "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
         "measured": True,
     }
+    # The smoke also proves the export path end-to-end: the spans the
+    # instrumented loop just emitted must round-trip through the
+    # Perfetto exporter into structurally valid trace-event JSON (the
+    # cheap no-viewer gate — parse + nesting check, nothing rendered).
+    import tempfile
+
+    from .obs.export import build_trace, validate_trace
+
+    stage("trace_export", spans=len(sink.records))
+    trace = build_trace(sink.records)
+    problems = validate_trace(trace)
+    trace_path = os.path.join(
+        tempfile.mkdtemp(prefix="dlcfn_obs_smoke_"), "trace.json")
+    with open(trace_path, "w") as fh:
+        json.dump(trace, fh)
+    with open(trace_path) as fh:
+        reparsed = json.load(fh)
+    trace_valid = (not problems
+                   and isinstance(reparsed.get("traceEvents"), list)
+                   and len(reparsed["traceEvents"]) > 0)
+    record["trace_json_path"] = trace_path
+    record["trace_events"] = len(trace["traceEvents"])
+    record["trace_valid"] = trace_valid
+    if problems:
+        record["trace_problems"] = problems[:5]
     stage("done", overhead_pct=record["value"])
     return record
 

@@ -698,14 +698,122 @@ def _cmd_obs_summarize(args) -> int:
         print(f"[dlcfn-tpu] ERROR: no metrics file or directory at {path}",
               file=sys.stderr)
         return 1
-    summary = summarize(path)
+    try:
+        summary = summarize(path, since_step=args.since_step)
+    except OSError as e:
+        print(f"[dlcfn-tpu] ERROR: cannot read {path}: {e}",
+              file=sys.stderr)
+        return 1
     if args.json:
         print(json.dumps(summary))
     else:
         print(render_report(summary))
     if summary["source"]["records"] == 0:
+        print(f"[dlcfn-tpu] no JSONL records found under {path} "
+              f"(empty run dir?)", file=sys.stderr)
         return 1
     return 0
+
+
+def _cmd_obs_export(args) -> int:
+    """JSONL streams → Chrome/Perfetto trace.json (load in
+    ui.perfetto.dev or chrome://tracing)."""
+    from ..obs.export import export_trace
+
+    path = args.path
+    if not os.path.exists(path):
+        print(f"[dlcfn-tpu] ERROR: no metrics file or directory at {path}",
+              file=sys.stderr)
+        return 1
+    out = args.out
+    if not out:
+        d = path if os.path.isdir(path) else os.path.dirname(path) or "."
+        out = os.path.join(d, "trace.json")
+    try:
+        summary = export_trace(path, out)
+    except OSError as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    for p in summary["problems"]:
+        print(f"[dlcfn-tpu] WARNING: trace problem: {p}", file=sys.stderr)
+    print(f"[dlcfn-tpu] wrote {summary['out']}: {summary['events']} "
+          f"events ({summary['spans']} spans) from {summary['records']} "
+          f"records — open in https://ui.perfetto.dev")
+    if summary["records"] == 0:
+        print(f"[dlcfn-tpu] no JSONL records found under {path}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def _cmd_obs_check(args) -> int:
+    """Evaluate SLO rules over a recorded run; rc=0 clean, rc=1 when any
+    rule fired (the CI gate), rc=2 on unusable inputs."""
+    from ..obs.slo import RuleError, check_run
+
+    if not os.path.exists(args.path):
+        print(f"[dlcfn-tpu] ERROR: no metrics file or directory at "
+              f"{args.path}", file=sys.stderr)
+        return 2
+    try:
+        result = check_run(args.path, args.rules)
+    except (RuleError, OSError) as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(result))
+    else:
+        for a in result["alerts"]:
+            print(f"ALERT {a['rule']}: {a.get('detail', '')}")
+        state = "OK" if result["ok"] else "BREACH"
+        print(f"[dlcfn-tpu] obs check {state}: {len(result['alerts'])} "
+              f"alert(s) from {result['rules']} rule(s) over "
+              f"{result['records']} records")
+    return 0 if result["ok"] else 1
+
+
+def _cmd_obs_diff(args) -> int:
+    """Align two runs' metric series and report p50/p95 deltas; rc=1 when
+    any shared metric regressed beyond --tolerance."""
+    from ..obs.diff import diff_runs, render_diff
+
+    for p in (args.run_a, args.run_b):
+        if not os.path.exists(p):
+            print(f"[dlcfn-tpu] ERROR: no metrics file or directory at "
+                  f"{p}", file=sys.stderr)
+            return 2
+    try:
+        report = diff_runs(args.run_a, args.run_b,
+                           tolerance=args.tolerance)
+    except OSError as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(render_diff(report))
+    return 0 if report["ok"] else 1
+
+
+def _cmd_obs_tail(args) -> int:
+    """Follow a live run's JSONL streams with a one-line status; optional
+    --rules evaluates SLOs as records arrive."""
+    from ..obs.tail import tail
+
+    engine = None
+    if args.rules:
+        from ..obs.slo import RuleError, SloEngine
+        try:
+            engine = SloEngine.from_file(args.rules)
+        except RuleError as e:
+            print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+            return 2
+    try:
+        return tail(args.path, interval_s=args.interval,
+                    max_seconds=args.duration or None, once=args.once,
+                    slo_engine=engine)
+    except KeyboardInterrupt:
+        return 0
 
 
 def _cli_store(args):
@@ -1128,7 +1236,64 @@ def build_parser() -> argparse.ArgumentParser:
     obsum.add_argument("--json", action="store_true",
                        help="emit the summary as one JSON object instead "
                             "of the text report")
+    obsum.add_argument("--since-step", type=int, default=None,
+                       help="ignore records with a numeric step below N "
+                            "(post-restart triage: report only the "
+                            "resumed window)")
     obsum.set_defaults(fn=_cmd_obs_summarize)
+
+    obexp = obsub.add_parser(
+        "export",
+        help="convert a run's span/metric JSONL into Chrome/Perfetto "
+             "trace-event JSON (trace.json, loadable in ui.perfetto.dev)")
+    obexp.add_argument("path", help="metrics.jsonl path or run directory")
+    obexp.add_argument("-o", "--out", default="",
+                       help="output path (default: trace.json next to "
+                            "the input)")
+    obexp.set_defaults(fn=_cmd_obs_export)
+
+    obchk = obsub.add_parser(
+        "check",
+        help="evaluate declarative SLO rules (threshold/percentile/drop) "
+             "over a run; nonzero exit on any breach — the CI gate")
+    obchk.add_argument("path", help="metrics.jsonl path or run directory")
+    obchk.add_argument("--rules", required=True,
+                       help="rules JSON file ({\"rules\": [...]}; see "
+                            "docs/OBSERVABILITY.md)")
+    obchk.add_argument("--json", action="store_true",
+                       help="emit the check result as one JSON object")
+    obchk.set_defaults(fn=_cmd_obs_check)
+
+    obdif = obsub.add_parser(
+        "diff",
+        help="align two runs' metric series and report p50/p95 deltas; "
+             "nonzero exit when a shared metric regressed beyond the "
+             "tolerance")
+    obdif.add_argument("run_a", help="baseline run (file or directory)")
+    obdif.add_argument("run_b", help="candidate run (file or directory)")
+    obdif.add_argument("--tolerance", type=float, default=0.10,
+                       help="relative regression tolerance on p50/p95 "
+                            "deltas (default 0.10 = 10%%)")
+    obdif.add_argument("--json", action="store_true",
+                       help="emit the diff report as one JSON object")
+    obdif.set_defaults(fn=_cmd_obs_diff)
+
+    obtail = obsub.add_parser(
+        "tail",
+        help="follow a live run's JSONL streams, rendering a one-line "
+             "train/serve status as records arrive (truncation-tolerant)")
+    obtail.add_argument("path", help="run directory or one JSONL file")
+    obtail.add_argument("--interval", type=float, default=1.0,
+                        help="poll interval seconds (default 1.0)")
+    obtail.add_argument("--duration", type=float, default=0.0,
+                        help="stop after N seconds (default: follow "
+                             "until interrupted)")
+    obtail.add_argument("--once", action="store_true",
+                        help="render the current status once and exit")
+    obtail.add_argument("--rules", default="",
+                        help="also evaluate SLO rules live, printing "
+                             "alerts as they fire")
+    obtail.set_defaults(fn=_cmd_obs_tail)
 
     # ckpt -------------------------------------------------------------------
     ck = sub.add_parser("ckpt", help="checkpoint inspection / rollback")

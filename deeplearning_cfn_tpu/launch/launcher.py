@@ -23,7 +23,8 @@ import time
 from typing import Callable, Dict, IO, List, Optional, Sequence
 
 from ..metrics.jsonl import MetricsWriter
-from ..obs.trace import span
+from ..obs.sinks import JsonlSink
+from ..obs.trace import get_tracer, span
 from ..runtime.cluster import ClusterSpec, cluster_env
 from ..runtime.watchdog import HANG_EXIT_CODE
 
@@ -242,6 +243,13 @@ class JobLauncher:
         # the launcher is host-side orchestration — no jax, no rank.
         events = MetricsWriter(os.path.join(log_dir, "launch.jsonl"),
                                also_stdout=False, all_processes=True)
+        # launch.attempt spans land in the same launch.jsonl as the
+        # attempt events (the trace exporter draws attempts as timeline
+        # bars from the spans and outcome markers from the events).
+        # Installed only for this run, then removed — the launcher may
+        # share a process with other tracer users.
+        span_sink = JsonlSink(events)
+        get_tracer().add_sink(span_sink)
         try:
             while True:
                 with span("launch.attempt", attempt=attempt,
@@ -267,4 +275,5 @@ class JobLauncher:
                 attempt += 1
                 time.sleep(min(2.0 ** attempt, 10.0))  # backoff before retry
         finally:
+            get_tracer().remove_sink(span_sink)
             events.close()

@@ -20,10 +20,21 @@ is the one telemetry layer under all of them:
   a run report (step-time p50/p95, tokens/sec, checkpoint latency +
   retries, queue wait, per-attempt launch outcomes) for train and serve
   runs alike.
+- :mod:`obs.export` — ``dlcfn-tpu obs export``: span/metric JSONL →
+  Chrome/Perfetto trace-event ``trace.json`` (the run as a flame view).
+- :mod:`obs.slo` — ``dlcfn-tpu obs check``: declarative SLO rules
+  (threshold / percentile / drop) streamed over the record stream,
+  emitting ``alert`` events and a CI-gateable exit code.
+- :mod:`obs.diff` — ``dlcfn-tpu obs diff``: align two runs' metric
+  series, report p50/p95 deltas, flag direction-aware regressions.
+- :mod:`obs.tail` — ``dlcfn-tpu obs tail``: truncation-tolerant live
+  follower rendering a one-line train/serve status as the JSONL grows.
 
 See docs/OBSERVABILITY.md for instrument/span naming conventions.
 """
 
+from .diff import diff_runs, render_diff  # noqa: F401
+from .export import build_trace, export_trace, validate_trace  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -33,6 +44,8 @@ from .metrics import (  # noqa: F401
     percentile,
 )
 from .report import render_report, summarize  # noqa: F401
+from .slo import AlertingWriter, SloEngine, check_run, load_rules  # noqa: F401
+from .tail import JsonlFollower, TailState, tail  # noqa: F401
 from .sinks import (  # noqa: F401
     JsonlSink,
     MemorySink,
@@ -49,6 +62,18 @@ from .trace import (  # noqa: F401
 )
 
 __all__ = [
+    "AlertingWriter",
+    "JsonlFollower",
+    "SloEngine",
+    "TailState",
+    "build_trace",
+    "check_run",
+    "diff_runs",
+    "export_trace",
+    "load_rules",
+    "render_diff",
+    "tail",
+    "validate_trace",
     "Counter",
     "Gauge",
     "Histogram",

@@ -26,6 +26,7 @@ hook thread both record into it.
 
 from __future__ import annotations
 
+import random
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -155,14 +156,26 @@ class Gauge(_Instrument):
             return dict(self._root()._values)
 
 
+# Raw-sample retention bound per histogram series: exact percentiles up
+# to this many observations; beyond it, uniform reservoir sampling keeps
+# memory flat (a multi-day serve run observes unboundedly many latencies).
+DEFAULT_MAX_SAMPLES = 8192
+
+# Fixed reservoir seed — sampling must be deterministic across runs, per
+# the repo rule that nothing in the metrics path reads wall-clock
+# randomness (reproducible runs, assertable tests).
+_RESERVOIR_SEED = 0x5EED
+
+
 class _HistSeries:
-    __slots__ = ("bucket_counts", "count", "total", "samples")
+    __slots__ = ("bucket_counts", "count", "total", "samples", "rng")
 
     def __init__(self, n_buckets: int):
         self.bucket_counts = [0] * (n_buckets + 1)  # +1 for +Inf
         self.count = 0
         self.total = 0.0
         self.samples: List[float] = []
+        self.rng: Optional[random.Random] = None  # created at first evict
 
 
 class Histogram(_Instrument):
@@ -170,13 +183,22 @@ class Histogram(_Instrument):
 
     ``keep_samples=False`` drops raw retention for genuinely hot series
     where only the bucketed export matters; percentiles then return None.
+
+    Retention is bounded: the first ``max_samples`` observations are kept
+    verbatim (percentiles exact — short runs see identical behavior to
+    unbounded retention), after which uniform reservoir sampling
+    (Algorithm R, deterministic seed) keeps a fixed-size representative
+    subset, so percentiles degrade to an unbiased approximation instead
+    of memory growing without bound. ``count``/``sum`` stay exact always.
     """
 
     kind = "histogram"
 
     def __init__(self, name: str, help: str = "", *,
                  buckets: Sequence[float] = DEFAULT_BUCKETS,
-                 keep_samples: bool = True, lock: threading.Lock,
+                 keep_samples: bool = True,
+                 max_samples: int = DEFAULT_MAX_SAMPLES,
+                 lock: threading.Lock,
                  _parent=None, _bound=()):
         super().__init__(name, help, lock=lock, _parent=_parent,
                          _bound=_bound)
@@ -184,8 +206,11 @@ class Histogram(_Instrument):
             bs = tuple(sorted(float(b) for b in buckets))
             if not bs:
                 raise ValueError("histogram needs at least one bucket")
+            if max_samples < 1:
+                raise ValueError("max_samples must be >= 1")
             self.buckets = bs
             self.keep_samples = keep_samples
+            self.max_samples = max_samples
             self._series: Dict[LabelKey, _HistSeries] = {}
 
     def observe(self, v: float, **labels: str) -> None:
@@ -206,7 +231,14 @@ class Histogram(_Instrument):
             s.count += 1
             s.total += v
             if root.keep_samples:
-                s.samples.append(v)
+                if len(s.samples) < root.max_samples:
+                    s.samples.append(v)
+                else:
+                    if s.rng is None:
+                        s.rng = random.Random(_RESERVOIR_SEED)
+                    j = s.rng.randrange(s.count)
+                    if j < root.max_samples:
+                        s.samples[j] = v
 
     def _get(self, labels: Dict[str, str]) -> Optional[_HistSeries]:
         key = _label_key({**dict(self._bound), **labels})
@@ -273,9 +305,11 @@ class MetricsRegistry:
 
     def histogram(self, name: str, help: str = "", *,
                   buckets: Sequence[float] = DEFAULT_BUCKETS,
-                  keep_samples: bool = True) -> Histogram:
+                  keep_samples: bool = True,
+                  max_samples: int = DEFAULT_MAX_SAMPLES) -> Histogram:
         return self._get_or_create(Histogram, name, help, buckets=buckets,
-                                   keep_samples=keep_samples)
+                                   keep_samples=keep_samples,
+                                   max_samples=max_samples)
 
     def instruments(self) -> Iterable[_Instrument]:
         with self._lock:

@@ -52,7 +52,13 @@ def _iter_records(path: str) -> Tuple[List[Dict[str, Any]], int]:
 
 def collect(path: str) -> Tuple[List[Dict[str, Any]], List[str], int]:
     """Load records from a file, or every ``*.jsonl`` under a directory
-    (one level, plus ``logs/``). Returns (records, files, skipped)."""
+    (one level, plus ``logs/``). Returns (records, files, skipped).
+
+    A nonexistent path raises :class:`FileNotFoundError` with a usable
+    message; an unreadable individual file inside a directory is skipped
+    (a half-deleted run must still summarize), and an existing-but-empty
+    directory yields zero records rather than an exception.
+    """
     if os.path.isdir(path):
         files = []
         for sub in ("", "logs"):
@@ -63,10 +69,16 @@ def collect(path: str) -> Tuple[List[Dict[str, Any]], List[str], int]:
                     if f.endswith(".jsonl"))
         records, skipped = [], 0
         for f in files:
-            rs, sk = _iter_records(f)
+            try:
+                rs, sk = _iter_records(f)
+            except OSError:
+                continue
             records.extend(rs)
             skipped += sk
         return records, files, skipped
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no metrics file or run directory at {path}")
     records, skipped = _iter_records(path)
     return records, [path], skipped
 
@@ -75,14 +87,26 @@ def _pct_pair(xs: List[float]) -> Dict[str, Optional[float]]:
     return {"p50": percentile(xs, 50), "p95": percentile(xs, 95)}
 
 
-def summarize(path: str) -> Dict[str, Any]:
+def summarize(path: str,
+              since_step: Optional[int] = None) -> Dict[str, Any]:
     """Build the run-report dict. Always includes ``source``; train /
-    serve / spans / launch sections appear only when present."""
+    serve / spans / launch sections appear only when present.
+
+    ``since_step`` drops every record carrying a numeric ``step`` below
+    it (train records and step-tagged spans alike); step-less records
+    (serve snapshots, launch events) always pass — the filter narrows
+    the timeline, it doesn't hide subsystems."""
     records, files, skipped = collect(path)
+    if since_step is not None:
+        records = [r for r in records
+                   if not (isinstance(r.get("step"), (int, float))
+                           and r["step"] < since_step)]
     out: Dict[str, Any] = {
         "source": {"path": path, "files": len(files),
                    "records": len(records), "skipped_lines": skipped},
     }
+    if since_step is not None:
+        out["source"]["since_step"] = since_step
 
     train = [r for r in records if "step" in r and "span" not in r
              and not any(k.startswith("serve_") for k in r)]

@@ -1,0 +1,205 @@
+"""Live run follower: ``dlcfn-tpu obs tail <run_dir>``.
+
+Follows a run's JSONL streams as they grow and renders a one-line status
+(step, step time, examples/sec, loss | queue depth, tokens/sec | alert
+count) every time something changes — the "is it healthy right now"
+glance `obs summarize` can only give post-hoc.
+
+The follower is **truncation-tolerant** by construction:
+
+- a trailing partial line (the writer is mid-``write()``, or the process
+  crashed mid-line) is buffered until its newline arrives and is never
+  parsed early — so a torn line can only delay one record, not corrupt
+  the stream;
+- unparseable complete lines are counted and skipped, same as
+  ``obs summarize``;
+- a file that shrinks (rotation, restart from scratch) resets the read
+  offset to zero instead of erroring;
+- files that don't exist yet (``logs/launch.jsonl`` before the first
+  attempt finishes) are silently retried each poll.
+
+Optionally evaluates SLO rules live (``--rules``): alerts print as their
+own lines above the status, so a degrading run is visible the moment the
+rule fires, not at the postmortem.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+
+class JsonlFollower:
+    """Incremental reader of one JSONL file; ``poll()`` returns the
+    complete records appended since the previous call."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._pos = 0
+        self._buf = ""
+        self.skipped = 0
+
+    def poll(self) -> List[Dict[str, Any]]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size < self._pos:        # truncated/rotated: start over
+            self._pos = 0
+            self._buf = ""
+        if size == self._pos:
+            return []
+        try:
+            with open(self.path, "r") as fh:
+                fh.seek(self._pos)
+                chunk = fh.read()
+                self._pos = fh.tell()
+        except OSError:
+            return []
+        self._buf += chunk
+        # Everything before the last newline is complete; the remainder
+        # is a partial line held for the next poll.
+        if "\n" in self._buf:
+            complete, self._buf = self._buf.rsplit("\n", 1)
+            lines = complete.split("\n")
+        else:
+            return []
+        records = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                self.skipped += 1
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+            else:
+                self.skipped += 1
+        return records
+
+
+class TailState:
+    """Folds the record stream into the current one-line status."""
+
+    def __init__(self):
+        self.step: Optional[Any] = None
+        self.step_time_s: Optional[float] = None
+        self.examples_per_sec: Optional[float] = None
+        self.loss: Optional[float] = None
+        self.queue_depth: Optional[Any] = None
+        self.tokens_per_sec: Optional[float] = None
+        self.completed: Optional[Any] = None
+        self.submitted: Optional[Any] = None
+        self.alerts = 0
+        self.last_alert: Optional[str] = None
+        self.launch_outcome: Optional[str] = None
+        self.span_failures = 0
+        self.records = 0
+
+    def update(self, r: Dict[str, Any]) -> None:
+        self.records += 1
+        if r.get("event") == "alert":
+            self.alerts += 1
+            self.last_alert = str(r.get("rule", "?"))
+            return
+        if r.get("event") == "launch_attempt":
+            self.launch_outcome = str(r.get("outcome", "?"))
+            return
+        if "span" in r:
+            if r.get("ok") is False:
+                self.span_failures += 1
+            return
+        if any(k.startswith("serve_") for k in r):
+            for attr, key in (("queue_depth", "serve_queue_depth"),
+                              ("tokens_per_sec", "serve_tokens_per_sec"),
+                              ("completed", "serve_completed"),
+                              ("submitted", "serve_submitted")):
+                if key in r:
+                    setattr(self, attr, r[key])
+            return
+        for key in ("step", "step_time_s", "examples_per_sec", "loss"):
+            if key in r:
+                setattr(self, key, r[key])
+
+    def status_line(self) -> str:
+        def _f(v: Any) -> str:
+            if v is None:
+                return "-"
+            if isinstance(v, float):
+                return f"{v:.4g}"
+            return str(v)
+
+        parts = []
+        if self.step is not None or self.loss is not None:
+            sps = None
+            if isinstance(self.step_time_s, (int, float)) \
+                    and self.step_time_s > 0:
+                sps = 1.0 / self.step_time_s
+            parts.append(f"step {_f(self.step)} "
+                         f"({_f(sps)} steps/s, "
+                         f"{_f(self.examples_per_sec)} ex/s) "
+                         f"loss {_f(self.loss)}")
+        if self.submitted is not None or self.queue_depth is not None:
+            parts.append(f"serve q={_f(self.queue_depth)} "
+                         f"{_f(self.tokens_per_sec)} tok/s "
+                         f"done {_f(self.completed)}/{_f(self.submitted)}")
+        if self.launch_outcome is not None:
+            parts.append(f"launch {self.launch_outcome}")
+        alerts = f"alerts {self.alerts}"
+        if self.last_alert:
+            alerts += f" (last: {self.last_alert})"
+        if self.span_failures:
+            alerts += f" span-failures {self.span_failures}"
+        parts.append(alerts)
+        if not parts:
+            return "(no records yet)"
+        return " | ".join(parts)
+
+
+def _follow_paths(path: str) -> List[str]:
+    if os.path.isdir(path):
+        return [os.path.join(path, "metrics.jsonl"),
+                os.path.join(path, "logs", "launch.jsonl")]
+    return [path]
+
+
+def tail(path: str, interval_s: float = 1.0,
+         max_seconds: Optional[float] = None, once: bool = False,
+         slo_engine=None, out=None) -> int:
+    """Follow ``path`` (a run dir or one JSONL file), printing the status
+    line whenever it changes. ``once`` renders current state and returns
+    (tests and scripts); ``max_seconds`` bounds a follow. Returns 0."""
+    out = out if out is not None else sys.stdout
+    followers = [JsonlFollower(p) for p in _follow_paths(path)]
+    state = TailState()
+    deadline = (time.monotonic() + max_seconds
+                if max_seconds is not None else None)
+    last_line = None
+    while True:
+        for f in followers:
+            for rec in f.poll():
+                if slo_engine is not None and rec.get("event") != "alert":
+                    for alert in slo_engine.observe(rec):
+                        state.update(alert)
+                        print(f"ALERT {alert['rule']}: "
+                              f"{alert.get('detail', '')}", file=out)
+                state.update(rec)
+        line = state.status_line()
+        if line != last_line:
+            print(line, file=out)
+            try:
+                out.flush()
+            except (AttributeError, OSError):
+                pass
+            last_line = line
+        if once:
+            return 0
+        if deadline is not None and time.monotonic() >= deadline:
+            return 0
+        time.sleep(interval_s)

@@ -143,6 +143,38 @@ class Tracer:
         parent = stack[-1].span_id if stack else None
         return _Span(self, name, sid, parent, attrs)
 
+    def record_span(self, name: str, t0_monotonic: float, dur_s: float,
+                    parent_id: Optional[int] = None, ok: bool = True,
+                    **attrs) -> Optional[int]:
+        """Emit a span retroactively from timestamps the caller already
+        holds (``time.monotonic`` values on this tracer's clock). The
+        serving engine uses this for per-request lifecycle spans — a
+        request's queue wait and decode phases are only known at finish,
+        long after a ``with span(...)`` block could have bracketed them.
+
+        Returns the allocated span_id (so callers can parent children on
+        it), or None when tracing is disabled."""
+        if not obs_enabled():
+            return None
+        with self._id_lock:
+            sid = self._next_id
+            self._next_id += 1
+        dur_s = max(float(dur_s), 0.0)
+        self._dur_hist.observe(dur_s, name=name)
+        if self._sinks:
+            record = {
+                "span": name,
+                "span_id": sid,
+                "parent_id": parent_id,
+                "t0_s": round(t0_monotonic - self._epoch, 6),
+                "dur_s": round(dur_s, 6),
+                "ok": ok,
+                **attrs,
+            }
+            for sink in list(self._sinks):
+                sink.write(record)
+        return sid
+
     def _stack(self) -> List[_Span]:
         st = getattr(self._local, "stack", None)
         if st is None:

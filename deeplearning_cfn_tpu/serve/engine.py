@@ -452,6 +452,10 @@ class Engine:
         group.req.finished_at = now
         self._groups.remove(group)
         self.metrics.record_finish(state.value, group.req.latency_s)
+        # The request's whole lifecycle is known only now — emit it as
+        # retroactive submit->admit->finish spans tagged with the request
+        # id, the rows the trace exporter draws per request.
+        self.metrics.record_request_trace(group.req)
 
     def _finalize_beam(self, group: _Group) -> None:
         """Best-hypothesis pick, exactly beam_decode_cached's rule: GNMT
@@ -710,15 +714,24 @@ class Engine:
         single-step logits path so beam parity is untouched."""
         now = self._clock()
         self._reap(now)
-        with span("serve.admit", queued=self.queue.depth):
+        with span("serve.admit", queued=self.queue.depth) as sp:
+            before = len(self._groups)
             self._admit(now)
+            if len(self._groups) > before:
+                # Tag the tick with what it admitted, so the exporter can
+                # correlate engine spans with serve.request lifecycles.
+                sp.annotate(request_ids=[
+                    g.req.id for g in self._groups[before:]])
         if not self._groups:
             return 0
+        active_ids = [g.req.id for g in self._groups]
         if any(g.req.beam_size > 1 for g in self._groups):
-            with span("serve.decode", path="host", k=1):
+            with span("serve.decode", path="host", k=1,
+                      request_ids=active_ids):
                 return self._host_step()
         k = self._plan_window()
-        with span("serve.decode", path="fused", k=k):
+        with span("serve.decode", path="fused", k=k,
+                  request_ids=active_ids):
             return self._fused_step(k)
 
     def _fused_step(self, k: int) -> int:

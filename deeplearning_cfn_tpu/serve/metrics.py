@@ -33,6 +33,7 @@ from typing import Dict, List, Optional
 
 from ..metrics.jsonl import MetricsWriter
 from ..obs.metrics import MetricsRegistry, percentile  # noqa: F401  (re-export)
+from ..obs.trace import get_tracer, obs_enabled
 
 
 class ServeMetrics:
@@ -142,6 +143,44 @@ class ServeMetrics:
                 state="completed" if state == "done" else state)
         if latency is not None:
             self._latency.observe(latency)
+
+    def record_request_trace(self, req) -> None:
+        """Emit the request's lifecycle as retroactive spans at finish:
+        one ``serve.request`` span (submit → finish, tagged with the
+        request id and terminal state) with ``serve.request.queue``
+        (submit → admit) and ``serve.request.decode`` (admit → finish)
+        children — the admit→decode phases the trace exporter renders as
+        a per-request gantt row. Timestamps are the engine-clock values
+        already on the request; a request rejected before admission has
+        no finished_at and emits nothing."""
+        if not obs_enabled():
+            return
+        t0 = getattr(req, "submitted_at", None)
+        t_end = getattr(req, "finished_at", None)
+        if not isinstance(t0, (int, float)) \
+                or not isinstance(t_end, (int, float)):
+            return
+        tracer = get_tracer()
+        state = getattr(req, "state", None)
+        parent = tracer.record_span(
+            "serve.request", t0, max(t_end - t0, 0.0),
+            request_id=getattr(req, "id", None),
+            state=getattr(state, "value", state),
+            beam_size=getattr(req, "beam_size", 1),
+            tokens=len(getattr(req, "tokens", ()) or ()),
+        )
+        if parent is None:
+            return
+        t_admit = getattr(req, "admitted_at", None)
+        if isinstance(t_admit, (int, float)):
+            tracer.record_span(
+                "serve.request.queue", t0, max(t_admit - t0, 0.0),
+                parent_id=parent, request_id=getattr(req, "id", None))
+            tracer.record_span(
+                "serve.request.decode", t_admit,
+                max(t_end - t_admit, 0.0), parent_id=parent,
+                request_id=getattr(req, "id", None),
+                ttft_s=getattr(req, "ttft_s", None))
 
     def record_step(self, active_rows: float, queue_depth: int,
                     new_tokens: int, step_time_s: float,

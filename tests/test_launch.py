@@ -58,12 +58,17 @@ def test_per_host_logs_aggregated(tmp_path):
                     "launch.jsonl"]
     text0 = (tmp_path / "logs" / logs[0]).read_text()
     assert "hello from rank 0" in text0
-    # Attempt lifecycle events land next to the host logs (obs report feed).
-    (event,) = [json.loads(line) for line in
-                (tmp_path / "logs" / "launch.jsonl").read_text().splitlines()]
+    # Attempt lifecycle events land next to the host logs (obs report feed),
+    # alongside the launch.attempt span the trace exporter draws as a bar.
+    records = [json.loads(line) for line in
+               (tmp_path / "logs" / "launch.jsonl").read_text().splitlines()]
+    (event,) = [r for r in records if r.get("event") == "launch_attempt"]
     assert event["event"] == "launch_attempt"
     assert event["attempt"] == 0 and event["outcome"] == "ok"
     assert event["success"] is True and event["exit_codes"] == [0, 0]
+    (span_rec,) = [r for r in records if r.get("span") == "launch.attempt"]
+    assert span_rec["attempt"] == 0 and span_rec["outcome"] == "ok"
+    assert span_rec["dur_s"] >= 0 and "ts" in span_rec
 
 
 def test_failure_kills_survivors_fast(tmp_path):
