@@ -335,10 +335,30 @@ def _train_on_stack(args, cfg: ExperimentConfig) -> int:
 
 
 def _cmd_bench(args) -> int:
-    if getattr(args, "smoke", False) and not getattr(args, "serve", False):
+    if getattr(args, "smoke", False) and not (
+            getattr(args, "serve", False) or getattr(args, "fleet", False)):
         print("[dlcfn-tpu] --smoke is a serving-scenario mode — pass it "
-              "with --serve", file=sys.stderr)
+              "with --serve or --fleet", file=sys.stderr)
         return 2
+    if getattr(args, "fleet", False):
+        if getattr(args, "ops", None) or args.collectives or \
+                getattr(args, "sweep_batches", None) or \
+                getattr(args, "serve", False):
+            print("[dlcfn-tpu] --fleet is its own scenario — don't combine "
+                  "with --serve/--ops/--collectives/--sweep-batches",
+                  file=sys.stderr)
+            return 2
+        from ..fleet.bench import run_fleet_bench
+
+        line = run_fleet_bench(replicas=args.fleet_replicas,
+                               num_requests=args.requests_count,
+                               slots=args.slots,
+                               decode_window=args.decode_window,
+                               policy=args.fleet_policy,
+                               chaos_kill_step=args.fleet_chaos_step,
+                               smoke=args.smoke)
+        print(json.dumps(line))
+        return 0
     if getattr(args, "obs_smoke", False):
         from ..bench import run_obs_overhead_smoke
 
@@ -554,6 +574,275 @@ def _cmd_serve(args) -> int:
     return 0
 
 
+# -- fleet ------------------------------------------------------------------
+
+
+def _fleet_read_trace(path: str, vocab: str):
+    """Parse a serve-style JSONL request trace into submit kwargs.
+    Returns (list of dicts, bpe_or_None) or raises ValueError/OSError."""
+    bpe = None
+    if vocab:
+        from ..data.bpe import Bpe
+
+        bpe = Bpe.load(vocab)
+    from ..models.decoding import EOS_ID
+
+    if path == "-":
+        lines = [ln for ln in sys.stdin if ln.strip()]
+    else:
+        with open(path) as fh:
+            lines = [ln for ln in fh if ln.strip()]
+    trace = []
+    for lineno, ln in enumerate(lines, 1):
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"bad JSON on requests line {lineno}: {e}")
+        if "src_ids" in rec:
+            src_ids = [int(t) for t in rec["src_ids"]]
+        elif "text" in rec:
+            if bpe is None:
+                raise ValueError(
+                    f"requests line {lineno} has \"text\" but no --vocab")
+            src_ids = bpe.encode(rec["text"]) + [EOS_ID]
+        else:
+            raise ValueError(
+                f"requests line {lineno} has neither \"src_ids\" nor "
+                f"\"text\"")
+        trace.append({"src_ids": src_ids, "line": ln.strip(),
+                      "rec": rec})
+    return trace, bpe
+
+
+def _fleet_build_replicas(args, n: int):
+    """N in-process engine replicas from the same checkpoint (fleet
+    route / rollout). One load per replica — each engine owns its jit
+    closures — but the restored weights are identical by construction."""
+    from ..fleet import EngineReplica
+    from ..serve.loader import load_engine
+
+    cfg0 = apply_overrides(get_preset(args.preset), args.overrides)
+    if args.accelerator:
+        cfg0.stack.accelerator = args.accelerator
+    if cfg0.stack.accelerator == "cpu":
+        from ..runtime.platform import force_cpu_platform
+
+        force_cpu_platform()
+    replicas, at_step = [], None
+    bpe = None
+    for i in range(n):
+        cfg = apply_overrides(get_preset(args.preset), args.overrides)
+        if args.accelerator:
+            cfg.stack.accelerator = args.accelerator
+        engine, bpe, at_step = load_engine(
+            cfg, capacity=args.slots,
+            default_max_new_tokens=args.max_new_tokens,
+            decode_window=args.decode_window,
+            vocab=args.vocab, allow_init=args.allow_init)
+        replicas.append(EngineReplica(f"replica-{i}", engine))
+    return replicas, bpe, at_step
+
+
+def _fleet_route_trace(router, trace, args):
+    """Submit the whole trace through the router with backpressure and
+    drain; returns the ordered logical request ids."""
+    from ..serve import OverloadError
+
+    rids = []
+    for item in trace:
+        rec = item["rec"]
+        kwargs = dict(
+            max_new_tokens=int(rec.get("max_new_tokens",
+                                       args.max_new_tokens)),
+            request_id=rec.get("id"),
+        )
+        while True:
+            try:
+                rids.append(router.submit(item["src_ids"], **kwargs))
+                break
+            except OverloadError:
+                if not router.step():
+                    raise
+    return rids
+
+
+def _fleet_print_results(router, rids, bpe):
+    import numpy as np
+
+    from ..models.decoding import strip_special
+
+    for rid in rids:
+        out = router.result(rid)
+        out["tokens"] = [int(t) for t in strip_special(out["tokens"])]
+        if bpe is not None:
+            out["text"] = bpe.decode(np.asarray(out["tokens"], np.int32))
+        print(json.dumps(out), flush=True)
+
+
+def _cmd_fleet_up(args) -> int:
+    """Run N serve child processes over a sharded request trace, each in
+    its own run dir under --run-root, supervised with hang-vs-crash
+    classification and bounded restart; prints the fleet report when
+    every replica drains."""
+    from ..fleet import ReplicaProcSpec, ReplicaSupervisor
+    from ..obs.report import render_fleet_report, summarize_fleet
+
+    cfg = apply_overrides(get_preset(args.preset), args.overrides)
+    if args.accelerator:
+        cfg.stack.accelerator = args.accelerator
+    try:
+        with open(args.requests) as fh:
+            lines = [ln for ln in fh if ln.strip()]
+    except OSError as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    run_root = args.run_root or os.path.join(
+        cfg.workdir, args.preset, "fleet")
+    os.makedirs(run_root, exist_ok=True)
+    specs = []
+    for i in range(args.replicas):
+        run_dir = os.path.join(run_root, f"replica-{i}")
+        os.makedirs(run_dir, exist_ok=True)
+        # .json, not .jsonl: the run dir's *.jsonl files are the obs
+        # streams (`obs summarize` globs them) — the input shard is not
+        # a metrics stream.
+        shard_path = os.path.join(run_dir, "requests.json")
+        # Round-robin sharding: deterministic, and every replica gets a
+        # representative slice of the trace.
+        with open(shard_path, "w") as fh:
+            for ln in lines[i::args.replicas]:
+                fh.write(ln if ln.endswith("\n") else ln + "\n")
+        argv = [sys.executable, "-m", "deeplearning_cfn_tpu.cli", "serve",
+                "--preset", args.preset,
+                "--requests", shard_path,
+                "--metrics-path", os.path.join(run_dir, "metrics.jsonl"),
+                "--slots", str(args.slots),
+                "--max-new-tokens", str(args.max_new_tokens),
+                "--decode-window", str(args.decode_window),
+                "--emit-every", str(args.emit_every)]
+        if args.accelerator:
+            argv += ["--accelerator", args.accelerator]
+        if args.vocab:
+            argv += ["--vocab", args.vocab]
+        if args.allow_init:
+            argv += ["--allow-init"]
+        argv += list(args.overrides)
+        specs.append(ReplicaProcSpec(
+            replica_id=f"replica-{i}", argv=argv, run_dir=run_dir))
+    sup = ReplicaSupervisor(specs, max_restarts=args.max_restarts)
+    print(f"[dlcfn-tpu] fleet up: {args.replicas} replica(s), "
+          f"{len(lines)} request(s), run root {run_root}",
+          file=sys.stderr)
+    sup.start()
+    try:
+        all_ok = sup.wait(timeout_s=args.timeout or None)
+    except KeyboardInterrupt:
+        sup.terminate()
+        sup.close()
+        return 1
+    if not all_ok:
+        sup.terminate()
+    sup.close()
+    for row in sup.status():
+        print(f"[dlcfn-tpu] {row['replica']}: {row['state']} "
+              f"(attempts: {row['attempt'] + 1}, "
+              f"outcomes: {','.join(row['outcomes']) or '-'})",
+              file=sys.stderr)
+    try:
+        print(render_fleet_report(summarize_fleet(run_root)))
+    except FileNotFoundError:
+        pass
+    return 0 if all_ok else 1
+
+
+def _cmd_fleet_route(args) -> int:
+    """In-process fleet: N engine replicas from one checkpoint behind
+    the router; routes a JSONL trace through the chosen policy and
+    prints one result line per request plus the fleet stats."""
+    from ..fleet import Router
+
+    try:
+        replicas, bpe, at_step = _fleet_build_replicas(args, args.replicas)
+        trace, bpe2 = _fleet_read_trace(args.requests, args.vocab)
+    except (FileNotFoundError, ValueError, OSError) as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    bpe = bpe or bpe2
+    if at_step == -1:
+        print("[dlcfn-tpu] WARNING: fleet serving RANDOM weights "
+              "(--allow-init) — smoke mode only", file=sys.stderr)
+    router = Router(replicas, policy=args.policy)
+    rids = _fleet_route_trace(router, trace, args)
+    router.run_until_drained()
+    _fleet_print_results(router, rids, bpe)
+    stats = router.stats()
+    print(f"[dlcfn-tpu] fleet drained: {len(rids)} request(s) over "
+          f"{len(replicas)} replica(s), policy {router.policy.name}, "
+          f"dropped {stats['dropped_requests']}, "
+          f"routed " + ", ".join(
+              f"{rid}={s['routed']}"
+              for rid, s in stats["replicas"].items()), file=sys.stderr)
+    return 0 if stats["dropped_requests"] == 0 else 1
+
+
+def _cmd_fleet_rollout(args) -> int:
+    """Rolling checkpoint upgrade while serving: routes the trace,
+    upgrades every replica to --to-step mid-stream (drain → swap →
+    probe → readmit), keeps serving, and verifies zero drops."""
+    from ..fleet import Router, restore_swap_variables, rolling_upgrade
+
+    try:
+        replicas, bpe, at_step = _fleet_build_replicas(args, args.replicas)
+        trace, bpe2 = _fleet_read_trace(args.requests, args.vocab)
+        cfg = apply_overrides(get_preset(args.preset), args.overrides)
+        if args.accelerator:
+            cfg.stack.accelerator = args.accelerator
+        variables, to_step = restore_swap_variables(cfg, step=args.to_step)
+    except (FileNotFoundError, ValueError, OSError) as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    bpe = bpe or bpe2
+    router = Router(replicas, policy=args.policy)
+    # Submit the first half, upgrade mid-stream, submit the rest — the
+    # CLI shape of the end-to-end rolling-upgrade contract.
+    half = max(1, len(trace) // 2)
+    rids = _fleet_route_trace(router, trace[:half], args)
+    print(f"[dlcfn-tpu] rolling upgrade: step {at_step} -> {to_step} "
+          f"({len(replicas)} replica(s), one at a time)", file=sys.stderr)
+    report = rolling_upgrade(router, variables)
+    rids += _fleet_route_trace(router, trace[half:], args)
+    router.run_until_drained()
+    _fleet_print_results(router, rids, bpe)
+    stats = router.stats()
+    rep = report.to_dict()
+    print(f"[dlcfn-tpu] rollout {'OK' if rep['ok'] else 'FAILED'}: "
+          f"upgraded {len(rep['upgraded'])}/{len(replicas)}, "
+          f"dropped {stats['dropped_requests']}, "
+          f"evacuations {stats['evacuations']}", file=sys.stderr)
+    return 0 if rep["ok"] and stats["dropped_requests"] == 0 else 1
+
+
+def _cmd_fleet_status(args) -> int:
+    """Fleet-wide one-line status + per-replica report over a directory
+    of per-replica run dirs (the `fleet up` run root)."""
+    from ..obs.report import render_fleet_report, summarize_fleet
+
+    try:
+        summary = summarize_fleet(args.run_root)
+    except (FileNotFoundError, OSError) as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(render_fleet_report(summary))
+    if summary["source"]["replicas"] == 0:
+        print(f"[dlcfn-tpu] no replica run dirs under {args.run_root}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def _cmd_doctor(args) -> int:
     """Preflight: the reference-era 'verify drivers / EFA provider' role.
     Every check prints one line with a wall-clock timestamp so a hang is
@@ -691,13 +980,29 @@ def _cmd_obs_summarize(args) -> int:
     metrics.jsonl or a run directory — the obs subsystem's reporting verb.
     ``dlcfn-tpu metrics`` stays the quick one-line JSON summary; this one
     answers "what happened in this run"."""
-    from ..obs.report import render_report, summarize
+    from ..obs.report import (render_fleet_report, render_report,
+                              summarize, summarize_fleet)
 
     path = args.path
     if not os.path.exists(path):
         print(f"[dlcfn-tpu] ERROR: no metrics file or directory at {path}",
               file=sys.stderr)
         return 1
+    if getattr(args, "fleet", False):
+        try:
+            summary = summarize_fleet(path)
+        except (FileNotFoundError, OSError) as e:
+            print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(summary))
+        else:
+            print(render_fleet_report(summary))
+        if summary["source"]["replicas"] == 0:
+            print(f"[dlcfn-tpu] no replica run dirs under {path}",
+                  file=sys.stderr)
+            return 1
+        return 0
     try:
         summary = summarize(path, since_step=args.since_step)
     except OSError as e:
@@ -808,10 +1113,14 @@ def _cmd_obs_tail(args) -> int:
         except RuleError as e:
             print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
             return 2
+    if getattr(args, "fleet", False) and not os.path.isdir(args.path):
+        print(f"[dlcfn-tpu] ERROR: --fleet needs a directory of replica "
+              f"run dirs, got {args.path}", file=sys.stderr)
+        return 2
     try:
         return tail(args.path, interval_s=args.interval,
                     max_seconds=args.duration or None, once=args.once,
-                    slo_engine=engine)
+                    slo_engine=engine, fleet=getattr(args, "fleet", False))
     except KeyboardInterrupt:
         return 0
 
@@ -1138,6 +1447,96 @@ def build_parser() -> argparse.ArgumentParser:
                          "training run used")
     sv.set_defaults(fn=_cmd_serve)
 
+    # fleet ------------------------------------------------------------------
+    fl = sub.add_parser(
+        "fleet",
+        help="multi-replica serving: supervised serve processes, request "
+             "routing, rolling checkpoint upgrades")
+    flsub = fl.add_subparsers(dest="fleet_command", required=True)
+
+    def _add_fleet_engine_flags(p, requests_required=True):
+        p.add_argument("--preset", required=True)
+        p.add_argument("--accelerator", default="",
+                       choices=["", "tpu", "cpu"])
+        p.add_argument("--requests", required=requests_required,
+                       help="JSONL request trace path, or - for stdin "
+                            "(same line format as `serve`)")
+        p.add_argument("--replicas", type=int, default=2,
+                       help="replica count (default 2)")
+        p.add_argument("--slots", type=int, default=4,
+                       help="per-replica slot-table capacity")
+        p.add_argument("--max-new-tokens", type=int, default=64)
+        p.add_argument("--decode-window", type=int, default=4,
+                       help="fused decode steps per device call")
+        p.add_argument("--vocab", default="",
+                       help="BPE vocab.json — required for \"text\" "
+                            "requests")
+        p.add_argument("--allow-init", action="store_true",
+                       help="serve random weights when no checkpoint "
+                            "exists (smoke/CI mode)")
+
+    flup = flsub.add_parser(
+        "up",
+        help="one command → serving fleet: N supervised serve child "
+             "processes, the trace round-robin sharded across them, each "
+             "replica writing metrics/launch streams to its own run dir")
+    _add_fleet_engine_flags(flup)
+    flup.add_argument("--run-root", default="",
+                      help="fleet run root; per-replica run dirs are "
+                           "created under it (default: <workdir>/<preset>"
+                           "/fleet)")
+    flup.add_argument("--max-restarts", type=int, default=1,
+                      help="per-replica restart budget on hang/crash "
+                           "(default 1)")
+    flup.add_argument("--timeout", type=float, default=0.0,
+                      help="give up after N seconds (default: wait "
+                           "until every replica exits)")
+    flup.add_argument("--emit-every", type=int, default=20,
+                      help="per-replica metrics emission period in "
+                           "engine steps")
+    flup.add_argument("overrides", nargs="*",
+                      help="config overrides, forwarded to every replica")
+    flup.set_defaults(fn=_cmd_fleet_up)
+
+    flrt = flsub.add_parser(
+        "route",
+        help="in-process fleet: N engine replicas from one checkpoint "
+             "behind the router; one result line per request")
+    _add_fleet_engine_flags(flrt)
+    flrt.add_argument("--policy", default="least_loaded",
+                      choices=["least_loaded", "round_robin"],
+                      help="routing policy")
+    flrt.add_argument("overrides", nargs="*",
+                      help="config overrides — at least the workdir the "
+                           "training run used")
+    flrt.set_defaults(fn=_cmd_fleet_route)
+
+    flro = flsub.add_parser(
+        "rollout",
+        help="rolling checkpoint upgrade while serving: drain → swap → "
+             "probe → readmit, one replica at a time, zero dropped "
+             "requests")
+    _add_fleet_engine_flags(flro)
+    flro.add_argument("--policy", default="least_loaded",
+                      choices=["least_loaded", "round_robin"],
+                      help="routing policy")
+    flro.add_argument("--to-step", type=int, default=0,
+                      help="committed checkpoint step to upgrade to "
+                           "(0 = latest)")
+    flro.add_argument("overrides", nargs="*",
+                      help="config overrides — at least the workdir the "
+                           "training run used")
+    flro.set_defaults(fn=_cmd_fleet_rollout)
+
+    flst = flsub.add_parser(
+        "status",
+        help="fleet-wide status over a run root of per-replica run dirs: "
+             "total tokens/sec, worst p95, alert count, launch outcomes")
+    flst.add_argument("run_root", help="fleet run root (from `fleet up`)")
+    flst.add_argument("--json", action="store_true",
+                      help="emit the aggregate summary as one JSON object")
+    flst.set_defaults(fn=_cmd_fleet_status)
+
     # introspection ----------------------------------------------------------
     pr = sub.add_parser("presets", help="list training presets")
     pr.set_defaults(fn=_cmd_presets)
@@ -1209,6 +1608,20 @@ def build_parser() -> argparse.ArgumentParser:
     be.add_argument("--smoke", action="store_true",
                     help="serving scenario: CI fast mode (few requests, "
                          "tiny budget, same record contract)")
+    be.add_argument("--fleet", action="store_true",
+                    help="fleet scenario: the fixed trace routed across N "
+                         "in-process engine replicas; reports aggregate "
+                         "tokens/sec, per-replica utilization, and the "
+                         "zero-drop contract (dropped_requests)")
+    be.add_argument("--fleet-replicas", type=int, default=2,
+                    help="fleet scenario: replica count (default 2)")
+    be.add_argument("--fleet-policy", default="least_loaded",
+                    choices=["least_loaded", "round_robin"],
+                    help="fleet scenario: routing policy")
+    be.add_argument("--fleet-chaos-step", type=int, default=0,
+                    help="fleet scenario: crash-inject replica-0 on its "
+                         "Nth decode step (0 = off) — the chaos variant "
+                         "of the zero-drop contract")
     be.add_argument("--obs-smoke", action="store_true",
                     help="obs overhead smoke: step time instrumented vs "
                          "spans disabled (the <=5%% gate; use "
@@ -1240,6 +1653,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="ignore records with a numeric step below N "
                             "(post-restart triage: report only the "
                             "resumed window)")
+    obsum.add_argument("--fleet", action="store_true",
+                       help="treat PATH as a fleet run root (one run dir "
+                            "per replica) and aggregate: total tokens/sec, "
+                            "worst p95, alert count, per-replica lines")
     obsum.set_defaults(fn=_cmd_obs_summarize)
 
     obexp = obsub.add_parser(
@@ -1293,6 +1710,9 @@ def build_parser() -> argparse.ArgumentParser:
     obtail.add_argument("--rules", default="",
                         help="also evaluate SLO rules live, printing "
                              "alerts as they fire")
+    obtail.add_argument("--fleet", action="store_true",
+                        help="treat PATH as a fleet run root and render "
+                             "one aggregated fleet status line")
     obtail.set_defaults(fn=_cmd_obs_tail)
 
     # ckpt -------------------------------------------------------------------

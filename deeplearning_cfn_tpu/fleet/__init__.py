@@ -1,0 +1,69 @@
+"""fleet/ — multi-replica serving: router, supervision, rolling upgrades.
+
+One serve engine is one chip's worth of traffic; the north star is
+"millions of users". This subsystem is the layer between: N engine
+replicas behind an in-process router (the control plane), each replica
+either an in-process Engine (:class:`.replica.EngineReplica` — tests,
+benches, single-host fleets) or a supervised child process
+(:class:`.replica.ReplicaSupervisor` over the launcher's Transport
+abstraction — each with its own obs run dir). The reference repo's
+pitch was "one command → self-assembling fleet" for *training*; this is
+the serving half it never had.
+
+- :mod:`.replica` — replica state machine, health snapshots,
+  deterministic crash injection, process supervision with hang-vs-crash
+  classification and bounded restart.
+- :mod:`.router` — pluggable routing policies (round-robin,
+  least-loaded), retry-after-aware shedding (max ``retry_after_s``
+  propagated upstream), per-replica circuit breaking, crash failover
+  with zero dropped requests.
+- :mod:`.rollout` — rolling checkpoint upgrades: drain → swap → probe →
+  readmit, one replica at a time, fleet keeps serving throughout.
+- :mod:`.bench` — `dlcfn-tpu bench --fleet`: aggregate tokens/sec,
+  per-replica utilization, and the token-parity/zero-drop contract
+  record CI gates on.
+
+CLI surface: `dlcfn-tpu fleet up | route | rollout | status`.
+"""
+
+from .replica import (  # noqa: F401
+    EngineReplica,
+    ReplicaCrashed,
+    ReplicaProcSpec,
+    ReplicaState,
+    ReplicaSupervisor,
+)
+from .router import (  # noqa: F401
+    POLICIES,
+    FleetOverloadError,
+    LeastLoadedPolicy,
+    NoReplicasError,
+    Router,
+    RoundRobinPolicy,
+    RoutingPolicy,
+)
+from .rollout import (  # noqa: F401
+    ReplicaRolloutResult,
+    RolloutReport,
+    restore_swap_variables,
+    rolling_upgrade,
+)
+
+__all__ = [
+    "EngineReplica",
+    "FleetOverloadError",
+    "LeastLoadedPolicy",
+    "NoReplicasError",
+    "POLICIES",
+    "ReplicaCrashed",
+    "ReplicaProcSpec",
+    "ReplicaRolloutResult",
+    "ReplicaState",
+    "ReplicaSupervisor",
+    "RolloutReport",
+    "Router",
+    "RoundRobinPolicy",
+    "RoutingPolicy",
+    "restore_swap_variables",
+    "rolling_upgrade",
+]

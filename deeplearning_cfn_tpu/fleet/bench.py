@@ -1,0 +1,184 @@
+"""Fleet benchmark: the serve fixed trace routed across N replicas.
+
+`dlcfn-tpu bench --fleet` — same deterministic trace and tiny
+random-init NMT model as serve/bench.py, driven through the Router over
+N in-process engine replicas. The record keeps the BENCH_* contract
+shape and adds the fleet contract fields CI gates on: ``replicas``,
+``dropped_requests`` (must be 0 — the router's zero-drop guarantee),
+``per_replica`` utilization, and (in smoke mode) ``token_identical`` —
+the fleet's aggregate output compared token-for-token against a
+single-engine run of the same trace, which holds because greedy decode
+is deterministic and the router never loses a request.
+
+All replicas share ONE set of initialized weights (one ``model.init``),
+so parity with the single-engine baseline is exact by construction and
+the bench cost scales with compilation, not initialization.
+
+``chaos_kill_step > 0`` arms a runtime/faults.py crash spec that kills
+replica 0 mid-decode on its Nth step — the chaos-tested variant of the
+same contract (``dropped_requests`` still 0, tokens still identical).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+import numpy as np
+
+from ..runtime.faults import FaultPlan, FaultSpec
+from ..serve.bench import _fixed_trace
+from ..serve.engine import Engine
+from ..serve.metrics import percentile
+from ..serve.queue import OverloadError
+from .replica import EngineReplica
+from .router import Router
+
+METRIC = "fleet_tiny_nmt_tokens_per_sec"
+UNIT = "tokens/sec"
+
+
+def _single_engine_tokens(model, variables, trace: List[List[int]],
+                          slots: int, src_len: int, max_new_tokens: int,
+                          decode_window: int) -> List[List[int]]:
+    """The baseline: the same trace through ONE engine; returns the
+    per-trace-index token lists the fleet output must match."""
+    engine = Engine(model, variables, capacity=slots, max_src_len=src_len,
+                    queue_depth=len(trace) + 1,
+                    default_max_new_tokens=max_new_tokens,
+                    decode_window=decode_window)
+    ids = []
+    for src in trace:
+        while True:
+            try:
+                ids.append(engine.submit(
+                    src, max_new_tokens=max_new_tokens).id)
+                break
+            except OverloadError:
+                engine.step()
+    engine.run_until_drained()
+    return [list(engine.poll(i).tokens) for i in ids]
+
+
+def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
+                    slots: int = 2, max_new_tokens: int = 16,
+                    src_len: int = 12, seed: int = 0,
+                    decode_window: int = 4,
+                    policy: str = "least_loaded",
+                    chaos_kill_step: int = 0,
+                    smoke: bool = False) -> Dict:
+    """Route the fixed trace across ``replicas`` engines to drain;
+    return the BENCH-contract record with the fleet fields. ``smoke``
+    shrinks the scenario AND runs the single-engine parity baseline
+    (the t1.sh gate asserts ``token_identical`` and
+    ``dropped_requests == 0``)."""
+    import jax
+
+    from ..models.transformer_nmt import transformer_nmt_tiny
+
+    if replicas < 1:
+        raise ValueError(f"replicas must be >= 1, got {replicas}")
+    if smoke:
+        replicas = max(2, min(replicas, 2))
+        num_requests, slots = min(num_requests, 6), min(slots, 2)
+        max_new_tokens, src_len = min(max_new_tokens, 4), min(src_len, 8)
+
+    model = transformer_nmt_tiny(vocab_size=96, max_len=64)
+    init = model.init(
+        jax.random.PRNGKey(seed),
+        np.zeros((1, src_len), np.int32), np.ones((1, src_len), np.int32),
+        np.zeros((1, src_len), np.int32), train=False)
+    variables = {"params": init["params"]}
+    trace = _fixed_trace(num_requests, src_len, 96, seed=seed)
+
+    fault_plan = None
+    if chaos_kill_step > 0:
+        # chaos_kill_step is 1-based ("kill on the Nth router step of
+        # replica-0"); FaultSpec.at_calls counts per-site calls from 0.
+        fault_plan = FaultPlan([FaultSpec(
+            op="step", key="replica-0", kind="crash",
+            at_calls=(chaos_kill_step - 1,))])
+
+    members: List[EngineReplica] = []
+    warmup_tokens: Dict[str, int] = {}
+    for i in range(replicas):
+        engine = Engine(model, variables, capacity=slots,
+                        max_src_len=src_len,
+                        queue_depth=max(num_requests, 4),
+                        default_max_new_tokens=max_new_tokens,
+                        decode_window=decode_window)
+        rep = EngineReplica(f"replica-{i}", engine, fault_plan=fault_plan)
+        # Warmup per replica, outside the timed window (each engine owns
+        # its own jit closures, so each compiles independently).
+        engine.submit(trace[0], max_new_tokens=min(2, max_new_tokens))
+        engine.run_until_drained()
+        warmup_tokens[rep.id] = engine.metrics.tokens_generated
+        members.append(rep)
+    router = Router(members, policy=policy)
+
+    t0 = time.monotonic()
+    rids = []
+    for src in trace:
+        while True:
+            try:
+                rids.append(router.submit(
+                    src, max_new_tokens=max_new_tokens))
+                break
+            except OverloadError:
+                router.step()   # fleet backpressure: drain, then retry
+    ticks = router.run_until_drained()
+    elapsed = time.monotonic() - t0
+
+    results = [router.result(rid) for rid in rids]
+    done = [r for r in results if r["state"] == "done"]
+    # The contract number: every submitted logical request must reach
+    # DONE — anything else (backlogged, cancelled, expired) is a drop.
+    dropped = len(results) - len(done)
+    lat = [r["latency_s"] for r in done if r["latency_s"] is not None]
+    total_tokens = 0
+    per_replica = []
+    for rep in members:
+        m = rep.engine.metrics
+        toks = m.tokens_generated - warmup_tokens[rep.id]
+        total_tokens += toks
+        per_replica.append({
+            "replica": rep.id,
+            "state": rep.state.value,
+            "routed": router.routed.get(rep.id, 0),
+            "tokens": toks,
+            "decode_steps": m.steps,
+            "mean_slot_occupancy": round(m.mean_slot_occupancy or 0.0, 4),
+        })
+
+    token_identical = None
+    if smoke:
+        baseline = _single_engine_tokens(
+            model, variables, trace, slots, src_len, max_new_tokens,
+            decode_window)
+        fleet_tokens = [r["tokens"] for r in results]
+        token_identical = fleet_tokens == baseline
+
+    return {
+        "metric": METRIC,
+        "value": round(total_tokens / elapsed, 2) if elapsed > 0 else None,
+        "unit": UNIT,
+        "vs_baseline": None,
+        "mfu": None,
+        "measured": True,
+        "replicas": len(members),
+        "policy": router.policy.name,
+        "dropped_requests": dropped,
+        "evacuations": router.evacuations,
+        "chaos_kill_step": chaos_kill_step,
+        "token_identical": token_identical,
+        "p50_latency_s": percentile(lat, 50),
+        "p95_latency_s": percentile(lat, 95),
+        "requests": num_requests,
+        "slots": slots,
+        "max_new_tokens": max_new_tokens,
+        "decode_window": decode_window,
+        "fleet_ticks": ticks,
+        "per_replica": per_replica,
+        "smoke": smoke,
+        "device": jax.default_backend(),
+    }

@@ -1,0 +1,435 @@
+"""In-process request router over N engine replicas.
+
+The fleet's control plane: clients submit **logical requests** to the
+router; the router places each on one replica chosen by a pluggable
+policy, watches replica health, and guarantees the fleet-level contract
+the single engine cannot — **no request is ever dropped**:
+
+- *Overload*: when every routable replica rejects a submit, the router
+  raises :class:`FleetOverloadError` — a subclass of the engine's own
+  ``OverloadError`` carrying the **max** ``retry_after_s`` across the
+  replicas' hints (the most pessimistic replica bounds when retrying is
+  worth it), so existing backpressure loops (`except OverloadError`)
+  work unchanged one level up. Shedding propagates a number upstream; it
+  never silently drops.
+- *Crash*: a replica that dies mid-decode (:class:`ReplicaCrashed`) is
+  marked DOWN and every unfinished logical request it held is resubmitted
+  to a surviving replica. Greedy decode is deterministic, so the re-run
+  emits token-identical output — the fleet's aggregate answer matches a
+  single-engine run over the same trace even across a mid-stream kill.
+- *Circuit breaking*: ``breaker_threshold`` consecutive step failures on
+  one replica open its breaker (state BROKEN): it stops being routed and
+  stepped, its in-flight work is cancelled locally and resubmitted
+  elsewhere. :meth:`readmit` (after an operator or rollout health check)
+  closes the breaker.
+
+Policies are deterministic by construction — they sort on health
+snapshots and break ties by replica id, never wall-clock — so routing
+decisions replay identically in tests (the tests/test_fleet.py policy
+suite runs them over fake replicas with scripted loads).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+from ..serve.queue import OverloadError
+from .replica import EngineReplica, ReplicaCrashed, ReplicaState
+
+
+class FleetOverloadError(OverloadError):
+    """Every routable replica is full. ``retry_after_s`` is the MAX of
+    the per-replica hints — retrying sooner than the slowest replica's
+    estimate would just bounce off the same walls. ``per_replica`` keeps
+    the individual hints for diagnostics."""
+
+    def __init__(self, depth: int, max_depth: int,
+                 retry_after_s: Optional[float],
+                 per_replica: Optional[Dict[str, Optional[float]]] = None):
+        super().__init__(depth, max_depth, retry_after_s=retry_after_s)
+        self.per_replica = dict(per_replica or {})
+
+
+class NoReplicasError(RuntimeError):
+    """Zero routable replicas — not an overload (no amount of waiting
+    helps until a replica is readmitted or restarted)."""
+
+
+# -- policies ----------------------------------------------------------------
+
+
+class RoutingPolicy:
+    """Orders routable replicas by preference for one submit. ``order``
+    must be a pure function of the candidates' health snapshots (plus
+    policy-internal state advanced only by ``note_routed``) — no clocks,
+    no randomness — so selection is deterministic and testable."""
+
+    name = "policy"
+
+    def order(self, candidates: List[Tuple[str, Dict]]) -> List[str]:
+        """``candidates`` is [(replica_id, health)] in sorted-id order;
+        returns replica ids most-preferred first."""
+        raise NotImplementedError
+
+    def note_routed(self, replica_id: str) -> None:
+        """Called after a submit lands on ``replica_id``."""
+
+
+class RoundRobinPolicy(RoutingPolicy):
+    """Cycle through replica ids in sorted order, resuming after the last
+    replica actually routed to. Stable under removal/re-admission: the
+    cursor is an id, not an index, so a vanished replica just means the
+    rotation starts at the next id above it."""
+
+    name = "round_robin"
+
+    def __init__(self):
+        self._last: Optional[str] = None
+
+    def order(self, candidates):
+        ids = [rid for rid, _ in candidates]
+        if not ids:
+            return []
+        if self._last is None:
+            return ids
+        start = 0
+        for i, rid in enumerate(ids):
+            if rid > self._last:
+                start = i
+                break
+        else:
+            start = 0
+        return ids[start:] + ids[:start]
+
+    def note_routed(self, replica_id):
+        self._last = replica_id
+
+
+class LeastLoadedPolicy(RoutingPolicy):
+    """Prefer the replica with the least outstanding work (queued +
+    running), breaking ties by decode-step latency p50 (the slower
+    replica clears its backlog later even at equal depth) and finally by
+    replica id — the total order that keeps tied loads deterministic."""
+
+    name = "least_loaded"
+
+    def order(self, candidates):
+        def load_key(item):
+            rid, h = item
+            lat = h.get("step_latency_p50_s")
+            return (h.get("queue_depth", 0) + h.get("active_requests", 0),
+                    lat if lat is not None else 0.0,
+                    rid)
+        return [rid for rid, _ in sorted(candidates, key=load_key)]
+
+
+POLICIES = {
+    RoundRobinPolicy.name: RoundRobinPolicy,
+    LeastLoadedPolicy.name: LeastLoadedPolicy,
+}
+
+
+# -- the router --------------------------------------------------------------
+
+
+class _LogicalRequest:
+    """Router-side record of one client request: the submit spec (kept
+    verbatim so failover can replay it), where it currently lives, and
+    how many times it has been placed (the per-replica request id is
+    suffixed per attempt so a re-placement can never collide with a
+    cancelled copy's id)."""
+
+    def __init__(self, rid: str, spec: Dict):
+        self.rid = rid
+        self.spec = spec
+        self.replica_id: Optional[str] = None
+        self.replica_rid: Optional[str] = None
+        self.attempts = 0
+
+
+class Router:
+    """Routes logical requests across :class:`EngineReplica`s.
+
+    Drive it like an engine: ``submit`` (may raise
+    :class:`FleetOverloadError`), ``step`` (steps every steppable
+    replica once, handles failures), ``poll``/``results``,
+    ``run_until_drained``. Rollouts use ``drain``/``readmit``;
+    membership changes use ``add``/``remove``.
+    """
+
+    def __init__(self, replicas: List[EngineReplica],
+                 policy="least_loaded", breaker_threshold: int = 3):
+        if breaker_threshold < 1:
+            raise ValueError(
+                f"breaker_threshold must be >= 1, got {breaker_threshold}")
+        self._replicas: Dict[str, EngineReplica] = {}
+        for r in replicas:
+            if r.id in self._replicas:
+                raise ValueError(f"duplicate replica id {r.id!r}")
+            self._replicas[r.id] = r
+        self.policy = POLICIES[policy]() if isinstance(policy, str) \
+            else policy
+        self.breaker_threshold = breaker_threshold
+        self._failures: Dict[str, int] = {}
+        self._requests: Dict[str, _LogicalRequest] = {}
+        self._backlog: List[str] = []   # placed nowhere, awaiting capacity
+        self._auto_id = itertools.count()
+        self.routed: Dict[str, int] = {r.id: 0 for r in replicas}
+        self.evacuations = 0
+        # The fleet contract counter: logical requests lost with no
+        # terminal state and no path to one. Stays 0 — the bench record
+        # and the chaos tests assert it.
+        self.dropped_requests = 0
+
+    # -- membership ---------------------------------------------------------
+
+    def replica_ids(self) -> List[str]:
+        return sorted(self._replicas)
+
+    def replica(self, replica_id: str) -> EngineReplica:
+        return self._replicas[replica_id]
+
+    def add(self, replica: EngineReplica) -> None:
+        if replica.id in self._replicas:
+            raise ValueError(f"duplicate replica id {replica.id!r}")
+        self._replicas[replica.id] = replica
+        self.routed.setdefault(replica.id, 0)
+
+    def remove(self, replica_id: str) -> None:
+        """Take a replica out of the fleet, evacuating its in-flight
+        work to the survivors first."""
+        r = self._replicas[replica_id]
+        self._evacuate(replica_id, cancel_on_replica=not r.crashed)
+        del self._replicas[replica_id]
+        self._failures.pop(replica_id, None)
+
+    def _routable(self) -> List[EngineReplica]:
+        return [self._replicas[rid] for rid in self.replica_ids()
+                if self._replicas[rid].routable]
+
+    # -- submission / placement ---------------------------------------------
+
+    def submit(self, src_ids, max_new_tokens: Optional[int] = None,
+               beam_size: int = 1, deadline_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> str:
+        """Place one logical request; returns its id. Raises
+        :class:`FleetOverloadError` when every routable replica rejects
+        it (the request is NOT retained — the caller owns the retry),
+        :class:`NoReplicasError` when nothing is routable at all."""
+        rid = request_id if request_id is not None \
+            else f"fleet-{next(self._auto_id)}"
+        if rid in self._requests:
+            raise ValueError(f"duplicate request id {rid!r}")
+        lr = _LogicalRequest(rid, dict(
+            src_ids=list(src_ids), max_new_tokens=max_new_tokens,
+            beam_size=beam_size, deadline_s=deadline_s))
+        self._requests[rid] = lr
+        try:
+            self._place(lr)
+        except (FleetOverloadError, NoReplicasError, ValueError):
+            del self._requests[rid]
+            raise
+        return rid
+
+    def _place(self, lr: _LogicalRequest) -> None:
+        candidates = self._routable()
+        if not candidates:
+            raise NoReplicasError(
+                "no routable replicas (all down, broken, or draining)")
+        ordered = self.policy.order(
+            [(r.id, r.health()) for r in candidates])
+        hints: Dict[str, Optional[float]] = {}
+        depth = sum(r.engine.queue.depth for r in candidates)
+        max_depth = sum(r.engine.queue.max_depth for r in candidates)
+        for rep_id in ordered:
+            r = self._replicas[rep_id]
+            lr.attempts += 1
+            replica_rid = f"{lr.rid}#a{lr.attempts}"
+            try:
+                r.submit(lr.spec["src_ids"],
+                         max_new_tokens=lr.spec["max_new_tokens"],
+                         beam_size=lr.spec["beam_size"],
+                         deadline_s=lr.spec["deadline_s"],
+                         request_id=replica_rid)
+            except OverloadError as e:
+                hints[rep_id] = e.retry_after_s
+                continue
+            except ReplicaCrashed:
+                # Found it dead at submit time — handle like a step-time
+                # crash, keep trying the rest.
+                self._mark_down(r)
+                continue
+            lr.replica_id = rep_id
+            lr.replica_rid = replica_rid
+            self.policy.note_routed(rep_id)
+            self.routed[rep_id] = self.routed.get(rep_id, 0) + 1
+            return
+        retry_after = max((h for h in hints.values() if h is not None),
+                          default=None)
+        raise FleetOverloadError(depth, max_depth, retry_after,
+                                 per_replica=hints)
+
+    # -- stepping / failure handling ----------------------------------------
+
+    def step(self) -> int:
+        """One fleet tick: retry the backlog, step every steppable
+        replica, absorb failures (crash → evacuate; consecutive errors →
+        breaker). Returns total decode steps run."""
+        self._retry_backlog()
+        total = 0
+        for rep_id in self.replica_ids():
+            r = self._replicas[rep_id]
+            if not r.steppable or not r.busy:
+                continue
+            try:
+                total += r.step()
+                self._failures[rep_id] = 0
+            except ReplicaCrashed:
+                self._mark_down(r)
+            except Exception:
+                n = self._failures.get(rep_id, 0) + 1
+                self._failures[rep_id] = n
+                if n >= self.breaker_threshold:
+                    self._open_breaker(r)
+        return total
+
+    def _retry_backlog(self) -> None:
+        still: List[str] = []
+        for rid in self._backlog:
+            lr = self._requests[rid]
+            try:
+                self._place(lr)
+            except (FleetOverloadError, NoReplicasError):
+                still.append(rid)
+        self._backlog = still
+
+    def _mark_down(self, r: EngineReplica) -> None:
+        r.state = ReplicaState.DOWN
+        self._failures[r.id] = 0
+        # Dead process: nothing to cancel over there, just re-place.
+        self._evacuate(r.id, cancel_on_replica=False)
+
+    def _open_breaker(self, r: EngineReplica) -> None:
+        r.state = ReplicaState.BROKEN
+        # The replica is alive but untrusted: cancel its copies so its
+        # rows free up if it is ever stepped again, then re-place.
+        self._evacuate(r.id, cancel_on_replica=True)
+
+    def _evacuate(self, rep_id: str, cancel_on_replica: bool) -> None:
+        """Move every unfinished logical request off ``rep_id``. Requests
+        are re-placed immediately where capacity exists; the rest wait in
+        the backlog, retried every tick — never dropped."""
+        r = self._replicas[rep_id]
+        for lr in list(self._requests.values()):
+            if lr.replica_id != rep_id:
+                continue
+            try:
+                if lr.replica_rid is not None \
+                        and r.poll(lr.replica_rid).finished:
+                    continue   # completed before the failure — keep it
+            except (KeyError, ReplicaCrashed):
+                pass
+            if cancel_on_replica and lr.replica_rid is not None:
+                try:
+                    r.cancel(lr.replica_rid)
+                except (KeyError, ReplicaCrashed):
+                    pass
+            lr.replica_id = None
+            lr.replica_rid = None
+            self.evacuations += 1
+            try:
+                self._place(lr)
+            except (FleetOverloadError, NoReplicasError):
+                self._backlog.append(lr.rid)
+
+    # -- rollout surface ----------------------------------------------------
+
+    def drain(self, replica_id: str) -> None:
+        """Stop routing NEW work to a replica; in-flight requests keep
+        decoding (DRAINING replicas are still stepped)."""
+        r = self._replicas[replica_id]
+        if r.state is ReplicaState.HEALTHY:
+            r.state = ReplicaState.DRAINING
+
+    def readmit(self, replica_id: str) -> None:
+        """Close the breaker / end the drain: the replica is routable
+        again with a clean failure count."""
+        r = self._replicas[replica_id]
+        if r.crashed:
+            raise ReplicaCrashed(
+                f"replica {replica_id} is dead — restart it, don't "
+                f"readmit it")
+        r.state = ReplicaState.HEALTHY
+        self._failures[replica_id] = 0
+
+    def evacuate(self, replica_id: str) -> None:
+        """Forcibly move a replica's unfinished work elsewhere (the
+        rollout's drain-deadline escape hatch)."""
+        r = self._replicas[replica_id]
+        self._evacuate(replica_id, cancel_on_replica=not r.crashed)
+
+    # -- results ------------------------------------------------------------
+
+    def poll(self, rid: str):
+        """The live Request object for a logical request (from whichever
+        replica currently owns it); None while it waits in the backlog."""
+        lr = self._requests[rid]
+        if lr.replica_id is None or lr.replica_rid is None:
+            return None
+        return self._replicas[lr.replica_id].poll(lr.replica_rid)
+
+    def finished(self, rid: str) -> bool:
+        req = self.poll(rid)
+        return req is not None and req.finished
+
+    def pending(self) -> List[str]:
+        return [rid for rid in self._requests if not self.finished(rid)]
+
+    def result(self, rid: str) -> Dict:
+        req = self.poll(rid)
+        if req is None:
+            return {"id": rid, "state": "backlogged", "tokens": []}
+        out = req.to_dict()
+        out["id"] = rid   # logical id, not the per-attempt replica id
+        out["replica"] = self._requests[rid].replica_id
+        return out
+
+    def run_until_drained(self, max_steps: int = 1_000_000) -> int:
+        """Step until every logical request reaches a terminal state (or
+        the step budget runs out — leftover unfinished requests are then
+        counted as dropped, the number the zero-drop contract pins at 0).
+        Returns fleet ticks taken."""
+        steps = 0
+        while self.pending() and steps < max_steps:
+            before = self.step()
+            steps += 1
+            if before == 0 and not self._backlog_can_move():
+                break   # wedged: nothing steppable and nothing placeable
+        leftover = self.pending()
+        if leftover:
+            self.dropped_requests += len(leftover)
+        return steps
+
+    def _backlog_can_move(self) -> bool:
+        return bool(self._backlog) and bool(self._routable())
+
+    def stats(self) -> Dict:
+        per = {}
+        for rid in self.replica_ids():
+            r = self._replicas[rid]
+            h = r.health()
+            per[rid] = {
+                "state": r.state.value,
+                "routed": self.routed.get(rid, 0),
+                "tokens_generated": h["tokens_generated"],
+                "queue_depth": h["queue_depth"],
+                "active_requests": h["active_requests"],
+            }
+        return {
+            "replicas": per,
+            "requests": len(self._requests),
+            "backlog": len(self._backlog),
+            "evacuations": self.evacuations,
+            "dropped_requests": self.dropped_requests,
+        }

@@ -11,17 +11,21 @@ subsystem of SURVEY.md §6, which the reference lacked).
 """
 
 from .launcher import (
+    JobHandle,
     JobLauncher,
     JobResult,
     LocalTransport,
     SshTransport,
     Transport,
+    classify_attempt,
 )
 
 __all__ = [
+    "JobHandle",
     "JobLauncher",
     "JobResult",
     "LocalTransport",
     "SshTransport",
     "Transport",
+    "classify_attempt",
 ]

@@ -108,6 +108,84 @@ class _HostProc:
         self.log_file = log_file
 
 
+class JobHandle:
+    """One started attempt, observable without blocking.
+
+    :meth:`JobLauncher.run` owns its own watch loop; supervisors that
+    babysit MANY jobs at once (fleet/replica.py runs one per serve
+    replica) can't afford to block in it — they :meth:`poll` every handle
+    each tick and decide restarts themselves. The handle only observes;
+    restart policy stays with the caller.
+    """
+
+    def __init__(self, launcher: "JobLauncher", spec: ClusterSpec,
+                 log_dir: str, attempt: int, procs: List[_HostProc]):
+        self._launcher = launcher
+        self.spec = spec
+        self.log_dir = log_dir
+        self.attempt = attempt
+        self._procs = procs
+        self._closed = False
+
+    @property
+    def hosts(self) -> List[str]:
+        return [hp.host for hp in self._procs]
+
+    @property
+    def log_paths(self) -> List[str]:
+        return [hp.log_path for hp in self._procs]
+
+    def poll(self) -> List[Optional[int]]:
+        """Per-host exit codes right now; None = still running."""
+        return [hp.proc.poll() for hp in self._procs]
+
+    def alive(self) -> List[bool]:
+        """Per-host liveness (True = the process is still running)."""
+        return [c is None for c in self.poll()]
+
+    def done(self) -> bool:
+        return all(c is not None for c in self.poll())
+
+    def outcome(self) -> Optional[str]:
+        """``ok`` | ``hang`` | ``crash`` once every host has exited, else
+        None. Same classification :meth:`JobLauncher.run` records — a
+        supervisor triages a watchdog hang-exit differently from a real
+        crash (restart helps the latter, a wedged collective wants the
+        whole gang re-fanned)."""
+        codes = self.poll()
+        if any(c is None for c in codes):
+            return None
+        return classify_attempt(codes)
+
+    def wait(self, timeout_s: Optional[float] = None
+             ) -> List[Optional[int]]:
+        """Block until every host exits (or the timeout); returns the
+        codes as :meth:`poll` would — None entries mean timed out."""
+        deadline = None if timeout_s is None else time.time() + timeout_s
+        while not self.done():
+            if deadline is not None and time.time() >= deadline:
+                break
+            time.sleep(self._launcher.poll_interval_s)
+        return self.poll()
+
+    def terminate(self) -> None:
+        """Kill every still-running host process (SIGTERM, then SIGKILL
+        after a grace period) and close the log files."""
+        self._launcher._kill_all(self._procs)
+        self.close()
+
+    def close(self) -> None:
+        """Close per-host log files once the attempt is over."""
+        if self._closed:
+            return
+        self._closed = True
+        for hp in self._procs:
+            try:
+                hp.log_file.close()
+            except OSError:
+                pass
+
+
 class JobLauncher:
     """Fans one argv to all hosts and babysits the job.
 
@@ -132,6 +210,7 @@ class JobLauncher:
         self.max_restarts = max_restarts
         self.poll_interval_s = poll_interval_s
         self.tail_rank0 = tail_rank0
+        self._handle: Optional[JobHandle] = None
 
     # -- single attempt -----------------------------------------------------
 
@@ -223,6 +302,35 @@ class JobLauncher:
                 hp.log_file.close()
 
     # -- public -------------------------------------------------------------
+
+    def start(
+        self,
+        spec: ClusterSpec,
+        argv: Sequence[str],
+        log_dir: str,
+        attempt: int = 0,
+        extra_env: Optional[Dict[str, str]] = None,
+        cwd: Optional[str] = None,
+    ) -> JobHandle:
+        """Start one attempt without blocking; returns a :class:`JobHandle`
+        the caller polls. No restart policy, no log tailing, no attempt
+        events — the non-blocking primitive a multi-job supervisor builds
+        its own loop from (:meth:`run` keeps the blocking single-job
+        contract unchanged)."""
+        os.makedirs(log_dir, exist_ok=True)
+        procs = self._start_all(spec, argv, log_dir, attempt,
+                                extra_env or {}, cwd)
+        handle = JobHandle(self, spec, log_dir, attempt, procs)
+        self._handle = handle
+        return handle
+
+    def poll(self) -> Optional[List[Optional[int]]]:
+        """Per-host exit codes of the most recently started attempt
+        (None entries = still running); None if :meth:`start` was never
+        called."""
+        if self._handle is None:
+            return None
+        return self._handle.poll()
 
     def run(
         self,

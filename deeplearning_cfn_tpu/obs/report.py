@@ -114,6 +114,7 @@ def summarize(path: str,
              if any(k.startswith("serve_") for k in r)]
     spans = [r for r in records if "span" in r]
     launch = [r for r in records if r.get("event") == "launch_attempt"]
+    alerts = [r for r in records if r.get("event") == "alert"]
 
     if train:
         steps = [r["step"] for r in train
@@ -209,6 +210,12 @@ def summarize(path: str,
             "restarts": max(0, len(launch) - 1),
         }
 
+    if alerts:
+        out["alerts"] = {
+            "count": len(alerts),
+            "last_rule": str(alerts[-1].get("rule", "?")),
+        }
+
     return out
 
 
@@ -291,6 +298,145 @@ def render_report(summary: Dict[str, Any]) -> str:
         L.append(f"  success             {_fmt(la['success'])}  "
                  f"restarts {la['restarts']}")
 
+    al = summary.get("alerts")
+    if al:
+        L.append("alerts:")
+        L.append(f"  count               {al['count']} "
+                 f"(last: {al['last_rule']})")
+
     if len(L) == 2:
         L.append("(no train, serve, span, or launch records found)")
+    return "\n".join(L)
+
+
+# -- fleet aggregate ---------------------------------------------------------
+
+
+def fleet_replica_dirs(root: str) -> List[Tuple[str, str]]:
+    """The per-replica run dirs under a fleet root: immediate
+    subdirectories that contain any ``*.jsonl`` (top level or
+    ``logs/``), sorted by name. Returns [(name, path)]."""
+    if not os.path.isdir(root):
+        raise FileNotFoundError(f"no fleet run directory at {root}")
+    found = []
+    for name in sorted(os.listdir(root)):
+        sub = os.path.join(root, name)
+        if not os.path.isdir(sub):
+            continue
+        has_jsonl = any(
+            f.endswith(".jsonl") for f in os.listdir(sub)) or (
+            os.path.isdir(os.path.join(sub, "logs"))
+            and any(f.endswith(".jsonl")
+                    for f in os.listdir(os.path.join(sub, "logs"))))
+        if has_jsonl:
+            found.append((name, sub))
+    return found
+
+
+def summarize_fleet(root: str) -> Dict[str, Any]:
+    """Fleet-wide report over a directory of per-replica run dirs (the
+    ReplicaSupervisor layout: ``<root>/replica-<i>/``). Per-replica
+    sections are full :func:`summarize` outputs; the ``fleet`` section
+    is the aggregate an operator triages from — total tokens/sec across
+    replicas, the WORST p95 request latency (the fleet is as slow as
+    its slowest replica), and the total alert count."""
+    dirs = fleet_replica_dirs(root)
+    replicas: Dict[str, Any] = {}
+    total_records = 0
+    tok_rates, worst_p95, alert_count = [], [], 0
+    tokens_total = 0
+    submitted = completed = rejected = 0
+    attempts, restarts, launch_fail = 0, 0, []
+    for name, path in dirs:
+        s = summarize(path)
+        replicas[name] = s
+        total_records += s["source"]["records"]
+        sv = s.get("serve")
+        if sv:
+            if isinstance(sv.get("tokens_per_sec"), (int, float)):
+                tok_rates.append(sv["tokens_per_sec"])
+            if isinstance(sv.get("tokens_generated"), (int, float)):
+                tokens_total += sv["tokens_generated"]
+            p95 = sv.get("latency_s", {}).get("p95")
+            if isinstance(p95, (int, float)):
+                worst_p95.append(p95)
+            for key, bucket in (("submitted", "submitted"),
+                                ("completed", "completed"),
+                                ("rejected", "rejected")):
+                v = sv.get(key)
+                if isinstance(v, (int, float)):
+                    if bucket == "submitted":
+                        submitted += v
+                    elif bucket == "completed":
+                        completed += v
+                    else:
+                        rejected += v
+        if s.get("alerts"):
+            alert_count += s["alerts"]["count"]
+        la = s.get("launch")
+        if la:
+            attempts += la["attempts"]
+            restarts += la["restarts"]
+            if not la["success"]:
+                launch_fail.append(name)
+    return {
+        "source": {"path": root, "replicas": len(dirs),
+                   "records": total_records},
+        "fleet": {
+            "tokens_per_sec": round(sum(tok_rates), 2)
+            if tok_rates else None,
+            "tokens_generated": tokens_total or None,
+            "worst_latency_p95_s": max(worst_p95) if worst_p95 else None,
+            "alerts": alert_count,
+            "submitted": submitted or None,
+            "completed": completed or None,
+            "rejected": rejected or None,
+            "launch_attempts": attempts or None,
+            "launch_restarts": restarts,
+            "launch_failed_replicas": launch_fail,
+        },
+        "replicas": replicas,
+    }
+
+
+def fleet_status_line(summary: Dict[str, Any]) -> str:
+    """The one-line fleet status (`dlcfn-tpu fleet status`)."""
+    f = summary["fleet"]
+    n = summary["source"]["replicas"]
+    return (f"fleet {n} replica(s) | {_fmt(f['tokens_per_sec'])} tok/s | "
+            f"done {_fmt(f['completed'])}/{_fmt(f['submitted'])} | "
+            f"worst p95 {_fmt(f['worst_latency_p95_s'], 's')} | "
+            f"alerts {f['alerts']}")
+
+
+def render_fleet_report(summary: Dict[str, Any]) -> str:
+    """Human rendering of :func:`summarize_fleet`: the aggregate line,
+    then one compact line per replica."""
+    L: List[str] = []
+    src = summary["source"]
+    L.append(f"fleet report: {src['path']}")
+    L.append(f"  {fleet_status_line(summary)}")
+    f = summary["fleet"]
+    if f["launch_attempts"]:
+        failed = (f" FAILED: {', '.join(f['launch_failed_replicas'])}"
+                  if f["launch_failed_replicas"] else "")
+        L.append(f"  launch: {f['launch_attempts']} attempt(s), "
+                 f"{f['launch_restarts']} restart(s){failed}")
+    for name, s in summary["replicas"].items():
+        sv = s.get("serve") or {}
+        la = s.get("launch") or {}
+        al = s.get("alerts") or {}
+        lat = sv.get("latency_s") or {}
+        bits = [f"{_fmt(sv.get('tokens_per_sec'))} tok/s",
+                f"done {_fmt(sv.get('completed'))}/"
+                f"{_fmt(sv.get('submitted'))}",
+                f"p95 {_fmt(lat.get('p95'), 's')}"]
+        if la:
+            bits.append(
+                f"launch {','.join(str(o) for o in la['outcomes'])}")
+        if al:
+            bits.append(f"alerts {al['count']}")
+        L.append(f"  {name:<16} " + " | ".join(bits))
+    if not summary["replicas"]:
+        L.append("  (no replica run dirs with records found)")
     return "\n".join(L)

@@ -94,6 +94,7 @@ class TailState:
         self.loss: Optional[float] = None
         self.queue_depth: Optional[Any] = None
         self.tokens_per_sec: Optional[float] = None
+        self.latency_p95_s: Optional[float] = None
         self.completed: Optional[Any] = None
         self.submitted: Optional[Any] = None
         self.alerts = 0
@@ -118,6 +119,7 @@ class TailState:
         if any(k.startswith("serve_") for k in r):
             for attr, key in (("queue_depth", "serve_queue_depth"),
                               ("tokens_per_sec", "serve_tokens_per_sec"),
+                              ("latency_p95_s", "serve_latency_p95_s"),
                               ("completed", "serve_completed"),
                               ("submitted", "serve_submitted")):
                 if key in r:
@@ -162,6 +164,51 @@ class TailState:
         return " | ".join(parts)
 
 
+class FleetTailState:
+    """Per-replica :class:`TailState`s folded into ONE fleet status
+    line: total tokens/sec and queue depth across replicas, aggregate
+    done/submitted, the WORST per-replica latency p95, total alerts —
+    the same aggregate `obs summarize --fleet` reports, live."""
+
+    def __init__(self, names: List[str]):
+        self.states: Dict[str, TailState] = {n: TailState() for n in names}
+
+    def update(self, name: str, rec: Dict[str, Any]) -> None:
+        self.states[name].update(rec)
+
+    def status_line(self) -> str:
+        def _f(v: Any) -> str:
+            if v is None:
+                return "-"
+            if isinstance(v, float):
+                return f"{v:.4g}"
+            return str(v)
+
+        def _sum(attr):
+            vals = [getattr(s, attr) for s in self.states.values()
+                    if isinstance(getattr(s, attr), (int, float))]
+            return sum(vals) if vals else None
+
+        live = sum(1 for s in self.states.values() if s.records)
+        if live == 0:
+            return f"fleet {len(self.states)} replica(s) | (no records yet)"
+        p95s = [s.latency_p95_s for s in self.states.values()
+                if isinstance(s.latency_p95_s, (int, float))]
+        alerts = sum(s.alerts for s in self.states.values())
+        parts = [f"fleet {live}/{len(self.states)} replica(s)",
+                 f"q={_f(_sum('queue_depth'))} "
+                 f"{_f(_sum('tokens_per_sec'))} tok/s",
+                 f"done {_f(_sum('completed'))}/{_f(_sum('submitted'))}",
+                 f"worst p95 {_f(max(p95s) if p95s else None)}",
+                 f"alerts {alerts}"]
+        fails = {n: s.launch_outcome for n, s in self.states.items()
+                 if s.launch_outcome not in (None, "ok")}
+        if fails:
+            parts.append("launch " + ",".join(
+                f"{n}:{o}" for n, o in sorted(fails.items())))
+        return " | ".join(parts)
+
+
 def _follow_paths(path: str) -> List[str]:
     if os.path.isdir(path):
         return [os.path.join(path, "metrics.jsonl"),
@@ -169,28 +216,49 @@ def _follow_paths(path: str) -> List[str]:
     return [path]
 
 
+def _fleet_followers(root: str) -> List[tuple]:
+    """[(replica_name, JsonlFollower)] over every per-replica run dir
+    under ``root`` (discovered once at startup via the same filter
+    ``obs summarize --fleet`` uses; a fleet's membership is fixed for
+    the life of one `fleet up`)."""
+    from .report import fleet_replica_dirs
+
+    pairs = []
+    for name, sub in fleet_replica_dirs(root):
+        for p in _follow_paths(sub):
+            pairs.append((name, JsonlFollower(p)))
+    return pairs
+
+
 def tail(path: str, interval_s: float = 1.0,
          max_seconds: Optional[float] = None, once: bool = False,
-         slo_engine=None, out=None) -> int:
+         slo_engine=None, out=None, fleet: bool = False) -> int:
     """Follow ``path`` (a run dir or one JSONL file), printing the status
     line whenever it changes. ``once`` renders current state and returns
-    (tests and scripts); ``max_seconds`` bounds a follow. Returns 0."""
+    (tests and scripts); ``max_seconds`` bounds a follow. ``fleet``
+    treats ``path`` as a directory of per-replica run dirs and renders
+    ONE aggregated fleet status line. Returns 0."""
     out = out if out is not None else sys.stdout
-    followers = [JsonlFollower(p) for p in _follow_paths(path)]
-    state = TailState()
+    if fleet:
+        pairs = _fleet_followers(path)
+        fstate = FleetTailState([n for n, _ in pairs])
+    else:
+        pairs = [(None, JsonlFollower(p)) for p in _follow_paths(path)]
+        state = TailState()
     deadline = (time.monotonic() + max_seconds
                 if max_seconds is not None else None)
     last_line = None
     while True:
-        for f in followers:
+        for name, f in pairs:
             for rec in f.poll():
+                target = fstate.states[name] if fleet else state
                 if slo_engine is not None and rec.get("event") != "alert":
                     for alert in slo_engine.observe(rec):
-                        state.update(alert)
+                        target.update(alert)
                         print(f"ALERT {alert['rule']}: "
                               f"{alert.get('detail', '')}", file=out)
-                state.update(rec)
-        line = state.status_line()
+                target.update(rec)
+        line = fstate.status_line() if fleet else state.status_line()
         if line != last_line:
             print(line, file=out)
             try:

@@ -140,7 +140,9 @@ class Engine:
                  kv_blocks: int = 0,
                  prefix_cache_size: int = 0,
                  clock=time.monotonic,
-                 metrics: Optional[ServeMetrics] = None):
+                 metrics: Optional[ServeMetrics] = None,
+                 retry_after_floor_s: Optional[float]
+                 = RequestQueue.DEFAULT_RETRY_AFTER_FLOOR_S):
         if capacity <= 0:
             raise ValueError(f"capacity must be positive, got {capacity}")
         if decode_window <= 0:
@@ -163,7 +165,8 @@ class Engine:
                                           self.model_max_len - 1)
         self.length_penalty = length_penalty
         self._clock = clock
-        self.queue = RequestQueue(max_depth=queue_depth, clock=clock)
+        self.queue = RequestQueue(max_depth=queue_depth, clock=clock,
+                                  retry_after_floor_s=retry_after_floor_s)
         self.metrics = metrics if metrics is not None \
             else ServeMetrics(capacity, clock=clock)
 
@@ -347,6 +350,25 @@ class Engine:
     def slot_view(self) -> List[Optional[str]]:
         """Row → owning request id (None = free). For tests/diagnostics."""
         return list(self._row_owner)
+
+    def swap_variables(self, variables) -> None:
+        """Hot-swap model weights — the fleet rollout's checkpoint swap.
+
+        Only legal on an idle engine (no running groups, no queued work):
+        a mid-flight request's KV cache was computed under the old weights
+        and mixing generations would produce tokens neither checkpoint
+        would emit. The encoder prefix cache is dropped for the same
+        reason — its entries are old-weight encoder outputs. Compiled
+        functions are keyed on shapes only, so the swap costs no
+        recompilation."""
+        if self._groups or self.queue.depth > 0:
+            raise RuntimeError(
+                f"swap_variables requires an idle engine "
+                f"({len(self._groups)} running, {self.queue.depth} queued) "
+                f"— drain first")
+        self.variables = variables
+        if self._prefix is not None:
+            self._prefix = PrefixCache(self._prefix.max_entries)
 
     @property
     def active_requests(self) -> int:

@@ -51,7 +51,10 @@ class OverloadError(RuntimeError):
 
     ``retry_after_s`` is the p50 of recent queue waits (submit → admit):
     the queue's own estimate of how long backing off for one "turn" takes.
-    None when the queue has admitted nothing recently.
+    When the queue has admitted nothing yet (cold start) the hint falls
+    back to the queue's configured floor — a fleet router load-balances on
+    this number, so "retry later" with no number is not an answer. None
+    only when the floor itself is disabled (``retry_after_floor_s=None``).
     """
 
     def __init__(self, depth: int, max_depth: int,
@@ -115,12 +118,24 @@ class RequestQueue:
 
     ``max_depth`` bounds only the QUEUED set (running/finished requests
     stay pollable without counting against admission capacity).
+    ``retry_after_floor_s`` is the cold-start OverloadError hint: until
+    real queue-wait samples exist, rejections carry this number instead of
+    None (pass None to restore the old hint-less cold-start behavior).
     """
 
-    def __init__(self, max_depth: int = 64, clock=time.monotonic):
+    DEFAULT_RETRY_AFTER_FLOOR_S = 0.05
+
+    def __init__(self, max_depth: int = 64, clock=time.monotonic,
+                 retry_after_floor_s: Optional[float]
+                 = DEFAULT_RETRY_AFTER_FLOOR_S):
         if max_depth <= 0:
             raise ValueError(f"max_depth must be positive, got {max_depth}")
+        if retry_after_floor_s is not None and retry_after_floor_s < 0:
+            raise ValueError(
+                f"retry_after_floor_s must be non-negative, got "
+                f"{retry_after_floor_s}")
         self.max_depth = max_depth
+        self.retry_after_floor_s = retry_after_floor_s
         self._clock = clock
         self._lock = threading.Lock()
         self._pending: List[Request] = []
@@ -149,9 +164,11 @@ class RequestQueue:
         now = self._clock()
         with self._lock:
             if len(self._pending) >= self.max_depth:
+                hint = percentile(list(self._recent_waits), 50)
+                if hint is None:
+                    hint = self.retry_after_floor_s
                 raise OverloadError(
-                    len(self._pending), self.max_depth,
-                    retry_after_s=percentile(list(self._recent_waits), 50))
+                    len(self._pending), self.max_depth, retry_after_s=hint)
             rid = request_id if request_id is not None \
                 else f"req-{next(self._auto_id)}"
             if rid in self._by_id:

@@ -1,0 +1,728 @@
+"""fleet/ subsystem tests: routing policies, failover, circuit breaking,
+process supervision, fleet observability, and the end-to-end zero-drop /
+token-parity contract.
+
+The policy suite runs over FAKE replicas (a scripted engine lookalike, no
+JAX, no wall-clock) so every routing decision is deterministic and
+replayable; the end-to-end tests drive real engines on the tiny NMT model
+and pin the fleet's aggregate output token-for-token against a
+single-engine run of the same trace — through a mid-stream rolling
+upgrade and through a chaos kill.
+"""
+
+import io
+import json
+import os
+import sys
+
+import pytest
+
+from deeplearning_cfn_tpu.fleet import (
+    EngineReplica,
+    FleetOverloadError,
+    NoReplicasError,
+    ReplicaCrashed,
+    ReplicaProcSpec,
+    ReplicaState,
+    ReplicaSupervisor,
+    Router,
+    rolling_upgrade,
+)
+from deeplearning_cfn_tpu.runtime.faults import FaultPlan, FaultSpec
+from deeplearning_cfn_tpu.serve.queue import (
+    OverloadError,
+    Request,
+    RequestState,
+)
+
+
+# -- fakes -------------------------------------------------------------------
+
+
+class _FakeQueue:
+    def __init__(self, max_depth):
+        self.max_depth = max_depth
+        self.items = []
+
+    @property
+    def depth(self):
+        return len(self.items)
+
+
+class _FakeMetrics:
+    def __init__(self):
+        self.step_latency_s = []
+        self.tokens_generated = 0
+        self.last_retry_after_s = None
+
+
+class FakeEngine:
+    """Engine lookalike with scripted behavior: bounded queue, ``capacity``
+    slots, every admitted request finishes after ``work`` steps. ``fail_on``
+    is a set of step-call indices (1-based) that raise RuntimeError — the
+    breaker tests script consecutive-vs-interleaved failures with it."""
+
+    def __init__(self, capacity=2, queue_depth=8, retry_after=None,
+                 work=1, fail_on=()):
+        self.capacity = capacity
+        self.queue = _FakeQueue(queue_depth)
+        self.metrics = _FakeMetrics()
+        self.retry_after = retry_after
+        self.work = work
+        self.fail_on = set(fail_on)
+        self.step_calls = 0
+        self.variables = {"params": "v0"}
+        self._running = {}   # request id -> steps remaining
+        self._by_id = {}
+
+    @property
+    def active_requests(self):
+        return len(self._running)
+
+    def submit(self, src_ids, max_new_tokens=None, beam_size=1,
+               deadline_s=None, request_id=None):
+        if self.queue.depth >= self.queue.max_depth:
+            raise OverloadError(self.queue.depth, self.queue.max_depth,
+                                retry_after_s=self.retry_after)
+        rid = request_id if request_id is not None \
+            else f"fake-{len(self._by_id)}"
+        req = Request(id=rid, src_ids=list(src_ids),
+                      max_new_tokens=max_new_tokens or 4,
+                      beam_size=beam_size)
+        self.queue.items.append(req)
+        self._by_id[rid] = req
+        return req
+
+    def poll(self, request_id):
+        if request_id not in self._by_id:
+            raise KeyError(request_id)
+        return self._by_id[request_id]
+
+    def cancel(self, request_id):
+        req = self.poll(request_id)
+        if req.finished:
+            return False
+        req.state = RequestState.CANCELLED
+        if req in self.queue.items:
+            self.queue.items.remove(req)
+        self._running.pop(req.id, None)
+        return True
+
+    def step(self):
+        self.step_calls += 1
+        if self.step_calls in self.fail_on:
+            raise RuntimeError(f"scripted step failure {self.step_calls}")
+        while self.queue.items and len(self._running) < self.capacity:
+            req = self.queue.items.pop(0)
+            if req.finished:
+                continue
+            req.state = RequestState.RUNNING
+            self._running[req.id] = self.work
+        decoded = 0
+        for rid in list(self._running):
+            req = self._by_id[rid]
+            self._running[rid] -= 1
+            req.tokens.append(1)
+            decoded += 1
+            self.metrics.tokens_generated += 1
+            if self._running[rid] <= 0:
+                req.state = RequestState.DONE
+                req.finished_at = 0.0
+                del self._running[rid]
+        return decoded
+
+    def run_until_drained(self, max_steps=1_000_000, **_):
+        steps = 0
+        while (self.queue.items or self._running) and steps < max_steps:
+            self.step()
+            steps += 1
+        return steps
+
+    def swap_variables(self, variables):
+        if self.queue.items or self._running:
+            raise RuntimeError("swap_variables requires an idle engine")
+        self.variables = variables
+
+
+def _fake_replica(rid, **kwargs):
+    fault_plan = kwargs.pop("fault_plan", None)
+    return EngineReplica(rid, FakeEngine(**kwargs), fault_plan=fault_plan)
+
+
+def _placements(router, rids):
+    return [router._requests[rid].replica_id for rid in rids]
+
+
+# -- policies ----------------------------------------------------------------
+
+
+def test_round_robin_cycles_in_id_order():
+    reps = [_fake_replica(f"replica-{i}", capacity=8, queue_depth=8)
+            for i in range(3)]
+    router = Router(reps, policy="round_robin")
+    rids = [router.submit([5, 4, 3]) for _ in range(6)]
+    assert _placements(router, rids) == [
+        "replica-0", "replica-1", "replica-2",
+        "replica-0", "replica-1", "replica-2"]
+
+
+def test_round_robin_stable_under_removal_and_readmission():
+    reps = {f"replica-{i}": _fake_replica(f"replica-{i}", capacity=8,
+                                          queue_depth=8)
+            for i in range(3)}
+    router = Router(list(reps.values()), policy="round_robin")
+    first = router.submit([5, 4, 3])
+    assert _placements(router, [first]) == ["replica-0"]
+    # The cursor is an id, not an index: with replica-1 gone the rotation
+    # resumes at the next id above the cursor, deterministically.
+    router.remove("replica-1")
+    rids = [router.submit([5, 4, 3]) for _ in range(3)]
+    assert _placements(router, rids) == [
+        "replica-2", "replica-0", "replica-2"]
+    # Re-admission slots it back into the same total order.
+    router.add(reps["replica-1"])
+    rids = [router.submit([5, 4, 3]) for _ in range(3)]
+    assert _placements(router, rids) == [
+        "replica-0", "replica-1", "replica-2"]
+
+
+def test_round_robin_skips_drained_replica():
+    reps = [_fake_replica(f"replica-{i}", capacity=8, queue_depth=8)
+            for i in range(3)]
+    router = Router(reps, policy="round_robin")
+    router.drain("replica-1")
+    rids = [router.submit([5, 4, 3]) for _ in range(4)]
+    assert _placements(router, rids) == [
+        "replica-0", "replica-2", "replica-0", "replica-2"]
+    # Readmitted: the cursor (at replica-2) wraps, and replica-1 is back
+    # in the rotation exactly where its id sorts.
+    router.readmit("replica-1")
+    rids = [router.submit([5, 4, 3]) for _ in range(3)]
+    assert _placements(router, rids) == [
+        "replica-0", "replica-1", "replica-2"]
+
+
+def test_least_loaded_prefers_emptiest_and_ties_break_by_id():
+    reps = [_fake_replica(f"replica-{i}", capacity=8, queue_depth=8)
+            for i in range(2)]
+    router = Router(reps, policy="least_loaded")
+    # Tied (both empty) → lowest id wins, deterministically.
+    a = router.submit([5, 4, 3])
+    assert _placements(router, [a]) == ["replica-0"]
+    # replica-0 now carries work → next goes to replica-1; then tied
+    # again at one request each → replica-0.
+    b = router.submit([5, 4, 3])
+    c = router.submit([5, 4, 3])
+    assert _placements(router, [b, c]) == ["replica-1", "replica-0"]
+
+
+def test_least_loaded_ties_break_by_step_latency():
+    fast = _fake_replica("replica-0", capacity=8, queue_depth=8)
+    slow = _fake_replica("replica-1", capacity=8, queue_depth=8)
+    # Equal load, but replica-0 has a slower decode history: the tie
+    # goes to the faster replica despite its lower id losing the id
+    # tiebreak order (latency sorts before id).
+    fast.engine.metrics.step_latency_s = [0.5, 0.5]
+    slow.engine.metrics.step_latency_s = [0.01, 0.01]
+    router = Router([fast, slow], policy="least_loaded")
+    rid = router.submit([5, 4, 3])
+    assert _placements(router, [rid]) == ["replica-1"]
+
+
+# -- shedding / overload -----------------------------------------------------
+
+
+def test_fleet_overload_propagates_max_retry_after():
+    reps = [
+        _fake_replica("replica-0", capacity=1, queue_depth=1,
+                      retry_after=0.5),
+        _fake_replica("replica-1", capacity=1, queue_depth=1,
+                      retry_after=2.0),
+    ]
+    router = Router(reps, policy="round_robin")
+    router.submit([5, 4, 3])
+    router.submit([5, 4, 3])
+    with pytest.raises(FleetOverloadError) as ei:
+        router.submit([5, 4, 3])
+    # Shedding propagates the MAX hint upstream — retrying sooner than
+    # the slowest replica's estimate just bounces off the same walls.
+    assert ei.value.retry_after_s == 2.0
+    assert ei.value.per_replica == {"replica-0": 0.5, "replica-1": 2.0}
+    assert isinstance(ei.value, OverloadError)   # existing loops work
+    # The rejected request is NOT retained (the caller owns the retry).
+    assert router.stats()["requests"] == 2
+
+
+def test_no_replicas_error_when_nothing_routable():
+    reps = [_fake_replica("replica-0")]
+    router = Router(reps)
+    router.drain("replica-0")
+    with pytest.raises(NoReplicasError):
+        router.submit([5, 4, 3])
+
+
+def test_duplicate_request_id_rejected():
+    router = Router([_fake_replica("replica-0")])
+    router.submit([5, 4, 3], request_id="x")
+    with pytest.raises(ValueError):
+        router.submit([5, 4, 3], request_id="x")
+
+
+# -- circuit breaking / crash failover ---------------------------------------
+
+
+def test_breaker_opens_after_consecutive_failures_then_readmit():
+    bad = _fake_replica("replica-0", fail_on=(1, 2))
+    good = _fake_replica("replica-1")
+    router = Router([bad, good], policy="round_robin",
+                    breaker_threshold=2)
+    rid = router.submit([5, 4, 3])
+    assert _placements(router, [rid]) == ["replica-0"]
+    router.step()   # scripted failure 1 — breaker still closed
+    assert bad.state is ReplicaState.HEALTHY
+    router.step()   # scripted failure 2 — breaker opens
+    assert bad.state is ReplicaState.BROKEN
+    # The in-flight request was cancelled locally and re-placed on the
+    # survivor; it still finishes — nothing dropped.
+    assert _placements(router, [rid]) == ["replica-1"]
+    router.run_until_drained()
+    assert router.result(rid)["state"] == "done"
+    assert router.stats()["dropped_requests"] == 0
+    # Readmission closes the breaker with a clean failure count.
+    router.readmit("replica-0")
+    assert bad.state is ReplicaState.HEALTHY and bad.routable
+
+
+def test_breaker_failure_count_resets_on_success():
+    # Failures on calls 1 and 3, success on 2: never two CONSECUTIVE
+    # failures, so a threshold of 2 must not open.
+    flaky = _fake_replica("replica-0", fail_on=(1, 3), work=5)
+    router = Router([flaky], breaker_threshold=2)
+    router.submit([5, 4, 3])
+    for _ in range(4):
+        router.step()
+    assert flaky.state is ReplicaState.HEALTHY
+
+
+def test_crash_failover_resubmits_with_zero_drops():
+    # at_calls is 0-based per site: crash on replica-0's FIRST step.
+    plan = FaultPlan([FaultSpec(op="step", key="replica-0", kind="crash",
+                                at_calls=(0,))])
+    victim = _fake_replica("replica-0", fault_plan=plan)
+    survivor = _fake_replica("replica-1")
+    router = Router([victim, survivor], policy="round_robin")
+    a = router.submit([5, 4, 3])
+    b = router.submit([6, 5, 4])
+    assert _placements(router, [a, b]) == ["replica-0", "replica-1"]
+    router.run_until_drained()
+    assert victim.state is ReplicaState.DOWN and victim.crashed
+    # The victim's request was resubmitted to the survivor and finished.
+    assert _placements(router, [a, b]) == ["replica-1", "replica-1"]
+    assert router.result(a)["state"] == "done"
+    assert router.result(b)["state"] == "done"
+    assert router.stats()["dropped_requests"] == 0
+    assert router.evacuations == 1
+    # A dead replica cannot be readmitted — restart it instead.
+    with pytest.raises(ReplicaCrashed):
+        router.readmit("replica-0")
+
+
+def test_crashed_fleet_backlogs_until_capacity_returns():
+    plan = FaultPlan([FaultSpec(op="step", key="replica-0", kind="crash",
+                                at_calls=(0,))])
+    victim = _fake_replica("replica-0", fault_plan=plan)
+    router = Router([victim])
+    rid = router.submit([5, 4, 3])
+    router.step()   # crash; nowhere to evacuate → backlog, not a drop
+    assert router.result(rid)["state"] == "backlogged"
+    assert router.stats()["backlog"] == 1
+    # Capacity returns → the backlog drains on the next tick.
+    router.add(_fake_replica("replica-1"))
+    router.run_until_drained()
+    assert router.result(rid)["state"] == "done"
+    assert router.stats()["dropped_requests"] == 0
+
+
+# -- rolling upgrade (fakes) -------------------------------------------------
+
+
+def test_rolling_upgrade_drains_swaps_probes_readmits():
+    reps = [_fake_replica(f"replica-{i}", work=2) for i in range(2)]
+    router = Router(reps, policy="round_robin")
+    rids = [router.submit([5, 4, 3]) for _ in range(4)]
+    new_vars = {"params": "v1"}
+    report = rolling_upgrade(router, new_vars)
+    assert report.ok and report.upgraded == ["replica-0", "replica-1"]
+    for res in report.results:
+        assert res.drained and res.swapped and res.probe_ok \
+            and res.readmitted
+    for rep in reps:
+        assert rep.engine.variables is new_vars
+        assert rep.state is ReplicaState.HEALTHY
+    router.run_until_drained()
+    assert all(router.result(r)["state"] == "done" for r in rids)
+    assert router.stats()["dropped_requests"] == 0
+
+
+def test_rolling_upgrade_skips_replica_crashed_during_drain():
+    plan = FaultPlan([FaultSpec(op="step", key="replica-0", kind="crash",
+                                at_calls=(1,))])
+    victim = _fake_replica("replica-0", fault_plan=plan, work=3)
+    healthy = _fake_replica("replica-1", work=1)
+    router = Router([victim, healthy], policy="round_robin")
+    rids = [router.submit([5, 4, 3]) for _ in range(2)]
+    report = rolling_upgrade(router, {"params": "v1"})
+    by_id = {r.replica: r for r in report.results}
+    assert by_id["replica-0"].skipped == "crashed during drain"
+    assert by_id["replica-1"].readmitted
+    assert report.ok   # a chaos kill is not an upgrade FAILURE
+    router.run_until_drained()
+    assert all(router.result(r)["state"] == "done" for r in rids)
+    assert router.stats()["dropped_requests"] == 0
+
+
+# -- process supervision -----------------------------------------------------
+
+
+def _proc_spec(tmp_path, rid, code):
+    return ReplicaProcSpec(replica_id=rid, argv=[sys.executable, "-c", code],
+                           run_dir=str(tmp_path / rid))
+
+
+def _launch_events(tmp_path, rid):
+    path = tmp_path / rid / "logs" / "launch.jsonl"
+    with open(path) as fh:
+        return [json.loads(ln) for ln in fh if ln.strip()]
+
+
+def test_supervisor_runs_replicas_to_ok(tmp_path):
+    specs = [_proc_spec(tmp_path, f"replica-{i}", "print('serving')")
+             for i in range(2)]
+    sup = ReplicaSupervisor(specs, poll_interval_s=0.02)
+    sup.start()
+    assert sup.wait(timeout_s=30)
+    sup.close()
+    assert sup.status_states() == {"replica-0": "ok", "replica-1": "ok"}
+    for i in range(2):
+        evs = _launch_events(tmp_path, f"replica-{i}")
+        assert [e["outcome"] for e in evs
+                if e.get("event") == "launch_attempt"] == ["ok"]
+
+
+def test_supervisor_restarts_crash_within_budget(tmp_path):
+    # First run crashes, the restart succeeds: a marker file scripts the
+    # state across attempts.
+    marker = tmp_path / "attempted"
+    code = (f"import os,sys; p=r'{marker}'\n"
+            f"sys.exit(0) if os.path.exists(p) else "
+            f"(open(p,'w').close(), sys.exit(3))")
+    sup = ReplicaSupervisor([_proc_spec(tmp_path, "replica-0", code)],
+                            max_restarts=1, poll_interval_s=0.02)
+    sup.start()
+    assert sup.wait(timeout_s=30)
+    sup.close()
+    st = sup.status()[0]
+    assert st["state"] == "ok" and st["outcomes"] == ["crash", "ok"]
+    evs = [e for e in _launch_events(tmp_path, "replica-0")
+           if e.get("event") == "launch_attempt"]
+    assert [e["outcome"] for e in evs] == ["crash", "ok"]
+    assert [e["attempt"] for e in evs] == [0, 1]
+
+
+def test_supervisor_gives_up_after_restart_budget(tmp_path):
+    sup = ReplicaSupervisor(
+        [_proc_spec(tmp_path, "replica-0", "import sys; sys.exit(7)")],
+        max_restarts=1, poll_interval_s=0.02)
+    sup.start()
+    assert sup.wait(timeout_s=30) is False
+    sup.close()
+    st = sup.status()[0]
+    assert st["state"] == "failed"
+    assert st["outcomes"] == ["crash", "crash"]
+
+
+def test_supervisor_rejects_duplicate_ids(tmp_path):
+    with pytest.raises(ValueError):
+        ReplicaSupervisor([
+            _proc_spec(tmp_path, "replica-0", "pass"),
+            _proc_spec(tmp_path, "replica-0", "pass")])
+
+
+# -- fleet observability -----------------------------------------------------
+
+
+def _write_jsonl(path, records):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        for r in records:
+            fh.write(json.dumps(r) + "\n")
+
+
+def _fleet_root(tmp_path):
+    _write_jsonl(str(tmp_path / "replica-0" / "metrics.jsonl"), [
+        {"serve_submitted": 4, "serve_completed": 4,
+         "serve_tokens_per_sec": 100.0, "serve_tokens_generated": 40,
+         "serve_latency_p95_s": 0.2, "serve_rejected": 1},
+        {"event": "alert", "rule": "p95_latency"},
+    ])
+    _write_jsonl(str(tmp_path / "replica-0" / "logs" / "launch.jsonl"), [
+        {"event": "launch_attempt", "attempt": 0, "outcome": "ok",
+         "success": True},
+    ])
+    _write_jsonl(str(tmp_path / "replica-1" / "metrics.jsonl"), [
+        {"serve_submitted": 3, "serve_completed": 2,
+         "serve_tokens_per_sec": 50.5, "serve_tokens_generated": 21,
+         "serve_latency_p95_s": 0.7, "serve_rejected": 0},
+    ])
+    _write_jsonl(str(tmp_path / "replica-1" / "logs" / "launch.jsonl"), [
+        {"event": "launch_attempt", "attempt": 0, "outcome": "crash",
+         "success": False},
+        {"event": "launch_attempt", "attempt": 1, "outcome": "ok",
+         "success": True},
+    ])
+    # A non-replica subdir (no jsonl) must be ignored, not summarized.
+    os.makedirs(tmp_path / "scratch", exist_ok=True)
+    return str(tmp_path)
+
+
+def test_summarize_fleet_aggregates_across_replicas(tmp_path):
+    from deeplearning_cfn_tpu.obs.report import (
+        fleet_status_line,
+        render_fleet_report,
+        summarize_fleet,
+    )
+
+    s = summarize_fleet(_fleet_root(tmp_path))
+    assert s["source"]["replicas"] == 2
+    f = s["fleet"]
+    assert f["tokens_per_sec"] == 150.5          # sum across replicas
+    assert f["tokens_generated"] == 61
+    assert f["worst_latency_p95_s"] == 0.7       # worst, not mean
+    assert f["alerts"] == 1
+    assert f["submitted"] == 7 and f["completed"] == 6
+    assert f["rejected"] == 1
+    assert f["launch_attempts"] == 3 and f["launch_restarts"] == 1
+    assert f["launch_failed_replicas"] == []
+    assert set(s["replicas"]) == {"replica-0", "replica-1"}
+    line = fleet_status_line(s)
+    assert "fleet 2 replica(s)" in line and "150.5 tok/s" in line
+    assert "done 6/7" in line and "alerts 1" in line
+    report = render_fleet_report(s)
+    assert "replica-0" in report and "replica-1" in report
+    assert "launch: 3 attempt(s), 1 restart(s)" in report
+
+
+def test_summarize_fleet_missing_root_raises(tmp_path):
+    from deeplearning_cfn_tpu.obs.report import summarize_fleet
+
+    with pytest.raises(FileNotFoundError):
+        summarize_fleet(str(tmp_path / "nope"))
+
+
+def test_summarize_counts_alert_records(tmp_path):
+    from deeplearning_cfn_tpu.obs.report import render_report, summarize
+
+    path = str(tmp_path / "metrics.jsonl")
+    _write_jsonl(path, [
+        {"step": 1, "loss": 2.0},
+        {"event": "alert", "rule": "loss_spike"},
+        {"event": "alert", "rule": "p95_latency"},
+    ])
+    s = summarize(path)
+    assert s["alerts"] == {"count": 2, "last_rule": "p95_latency"}
+    assert "alerts" in render_report(s)
+
+
+def test_fleet_tail_renders_aggregate_line(tmp_path):
+    from deeplearning_cfn_tpu.obs.tail import tail
+
+    root = _fleet_root(tmp_path)
+    out = io.StringIO()
+    assert tail(root, once=True, fleet=True, out=out) == 0
+    line = out.getvalue().strip().splitlines()[-1]
+    assert line.startswith("fleet 2/2 replica(s)")
+    assert "150.5 tok/s" in line
+    assert "done 6/7" in line
+    assert "worst p95 0.7" in line
+    assert "alerts 1" in line
+
+
+def test_fleet_tail_empty_root(tmp_path):
+    from deeplearning_cfn_tpu.obs.tail import tail
+
+    out = io.StringIO()
+    assert tail(str(tmp_path), once=True, fleet=True, out=out) == 0
+    assert "(no records yet)" in out.getvalue()
+
+
+# -- CLI wiring --------------------------------------------------------------
+
+
+def test_cli_fleet_parsers_wire_handlers():
+    from deeplearning_cfn_tpu.cli.main import build_parser, main
+
+    parser = build_parser()
+    up = parser.parse_args(["fleet", "up", "--preset", "p",
+                            "--requests", "r.jsonl"])
+    assert up.fn.__name__ == "_cmd_fleet_up" and up.replicas == 2
+    rt = parser.parse_args(["fleet", "route", "--preset", "p",
+                            "--requests", "r.jsonl",
+                            "--policy", "round_robin"])
+    assert rt.fn.__name__ == "_cmd_fleet_route"
+    ro = parser.parse_args(["fleet", "rollout", "--preset", "p",
+                            "--requests", "r.jsonl", "--to-step", "5"])
+    assert ro.fn.__name__ == "_cmd_fleet_rollout" and ro.to_step == 5
+    st = parser.parse_args(["fleet", "status", "/tmp/x", "--json"])
+    assert st.fn.__name__ == "_cmd_fleet_status"
+    be = parser.parse_args(["bench", "--fleet", "--smoke",
+                            "--fleet-replicas", "3"])
+    assert be.fleet and be.fleet_replicas == 3
+    # --smoke without a serving scenario is still rejected...
+    assert main(["bench", "--smoke"]) == 2
+    # ...and --fleet refuses to combine with other scenarios.
+    assert main(["bench", "--fleet", "--serve"]) == 2
+
+
+def test_cli_obs_fleet_flags(tmp_path, capsys):
+    from deeplearning_cfn_tpu.cli.main import main
+
+    root = _fleet_root(tmp_path)
+    assert main(["obs", "summarize", root, "--fleet"]) == 0
+    assert "fleet 2 replica(s)" in capsys.readouterr().out
+    assert main(["obs", "summarize", root, "--fleet", "--json"]) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["fleet"]["tokens_per_sec"] == 150.5
+    assert main(["obs", "tail", root, "--fleet", "--once"]) == 0
+    assert "fleet 2/2" in capsys.readouterr().out
+    # --fleet tail needs a directory, not a file.
+    assert main(["obs", "tail",
+                 os.path.join(root, "replica-0", "metrics.jsonl"),
+                 "--fleet", "--once"]) == 2
+    assert main(["fleet", "status", root]) == 0
+    assert "fleet 2 replica(s)" in capsys.readouterr().out
+    assert main(["fleet", "status", str(tmp_path / "scratch")]) == 1
+
+
+# -- end to end: real engines, zero drops, token parity ----------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_fleet_setup():
+    """One tiny NMT init shared by every engine in this module (replicas
+    AND the single-engine baseline), a fixed trace, and the baseline's
+    per-request token lists."""
+    import jax
+    import numpy as np
+
+    from deeplearning_cfn_tpu.models.transformer_nmt import (
+        transformer_nmt_tiny,
+    )
+    from deeplearning_cfn_tpu.serve.bench import _fixed_trace
+    from deeplearning_cfn_tpu.serve.engine import Engine
+
+    src_len, max_new = 8, 4
+    model = transformer_nmt_tiny(vocab_size=96, max_len=64)
+    init = model.init(
+        jax.random.PRNGKey(0),
+        np.zeros((1, src_len), np.int32), np.ones((1, src_len), np.int32),
+        np.zeros((1, src_len), np.int32), train=False)
+    variables = {"params": init["params"]}
+    trace = _fixed_trace(6, src_len, 96, seed=0)
+
+    baseline_engine = Engine(model, variables, capacity=2,
+                             max_src_len=src_len, queue_depth=len(trace),
+                             default_max_new_tokens=max_new,
+                             decode_window=2)
+    ids = [baseline_engine.submit(src, max_new_tokens=max_new).id
+           for src in trace]
+    baseline_engine.run_until_drained()
+    baseline = [list(baseline_engine.poll(i).tokens) for i in ids]
+
+    def make_replicas(n, fault_plan=None):
+        reps = []
+        for i in range(n):
+            eng = Engine(model, variables, capacity=2, max_src_len=src_len,
+                         queue_depth=len(trace),
+                         default_max_new_tokens=max_new, decode_window=2)
+            reps.append(EngineReplica(f"replica-{i}", eng,
+                                      fault_plan=fault_plan))
+        return reps
+
+    return {"variables": variables, "trace": trace, "baseline": baseline,
+            "max_new": max_new, "make_replicas": make_replicas}
+
+
+def _route_all(router, trace, max_new):
+    rids = []
+    for src in trace:
+        while True:
+            try:
+                rids.append(router.submit(src, max_new_tokens=max_new))
+                break
+            except OverloadError:
+                router.step()
+    return rids
+
+
+def test_e2e_rolling_upgrade_mid_stream_token_parity(tiny_fleet_setup):
+    """The acceptance contract: a 2-replica fleet serves the fixed trace
+    while every replica is drained, checkpoint-swapped, and re-admitted
+    mid-stream — zero drops, aggregate output token-identical to the
+    single-engine run."""
+    s = tiny_fleet_setup
+    router = Router(s["make_replicas"](2), policy="least_loaded")
+    half = len(s["trace"]) // 2
+    rids = _route_all(router, s["trace"][:half], s["max_new"])
+    report = rolling_upgrade(router, s["variables"])
+    assert report.ok and len(report.upgraded) == 2
+    assert all(r.swapped and r.probe_ok for r in report.results)
+    rids += _route_all(router, s["trace"][half:], s["max_new"])
+    router.run_until_drained()
+    results = [router.result(rid) for rid in rids]
+    assert all(r["state"] == "done" for r in results)
+    assert router.stats()["dropped_requests"] == 0
+    assert [r["tokens"] for r in results] == s["baseline"]
+    # Both replicas ended the run back in rotation.
+    for rid in router.replica_ids():
+        assert router.replica(rid).state is ReplicaState.HEALTHY
+
+
+def test_e2e_chaos_kill_mid_decode_token_parity(tiny_fleet_setup):
+    """The chaos variant: runtime/faults.py kills replica-0 mid-decode;
+    its in-flight requests re-run on the survivor and the fleet aggregate
+    is STILL token-identical to the single-engine baseline."""
+    s = tiny_fleet_setup
+    plan = FaultPlan([FaultSpec(op="step", key="replica-0", kind="crash",
+                                at_calls=(2,))])
+    router = Router(s["make_replicas"](2, fault_plan=plan),
+                    policy="least_loaded")
+    rids = _route_all(router, s["trace"], s["max_new"])
+    router.run_until_drained()
+    victim = router.replica("replica-0")
+    assert victim.crashed and victim.state is ReplicaState.DOWN
+    assert router.evacuations >= 1
+    results = [router.result(rid) for rid in rids]
+    assert all(r["state"] == "done" for r in results)
+    assert router.stats()["dropped_requests"] == 0
+    assert [r["tokens"] for r in results] == s["baseline"]
+
+
+def test_fleet_bench_smoke_contract_record():
+    """`bench --fleet --smoke` record: the BENCH contract shape plus the
+    fleet gate fields t1.sh asserts on."""
+    from deeplearning_cfn_tpu.fleet.bench import run_fleet_bench
+
+    rec = run_fleet_bench(smoke=True)
+    assert rec["metric"] == "fleet_tiny_nmt_tokens_per_sec"
+    assert rec["unit"] == "tokens/sec"
+    assert rec["measured"] is True
+    assert rec["replicas"] == 2
+    assert rec["dropped_requests"] == 0
+    assert rec["token_identical"] is True
+    assert rec["smoke"] is True
+    assert len(rec["per_replica"]) == 2
+    for row in rec["per_replica"]:
+        assert row["state"] == "healthy"
+        assert row["routed"] > 0
+    assert sum(r["tokens"] for r in rec["per_replica"]) > 0
+    assert json.dumps(rec)   # one JSON line, like every bench record

@@ -324,3 +324,67 @@ def test_ssh_transport_fault_injection_restart_resumes(fake_ssh, tmp_path):
     assert "RESUMED rank 1" in log
     # Two attempts x two hosts = four ssh fan-outs recorded.
     assert len(_recorded_calls(fake_ssh)) == 4
+
+
+# -- non-blocking start/poll (JobHandle) -------------------------------------
+
+
+def test_start_returns_pollable_handle(tmp_path):
+    """start() never blocks: the handle reports per-host liveness while
+    the job runs and the classified outcome once every host exits."""
+    import time as _time
+
+    gate = tmp_path / "gate"
+    code = (
+        "import os, time\n"
+        f"while not os.path.exists(r'{gate}'): time.sleep(0.01)\n"
+    )
+    launcher = JobLauncher(transport=LocalTransport(), tail_rank0=False)
+    handle = launcher.start(_spec(2), _py(code), str(tmp_path / "logs"))
+    try:
+        assert handle.poll() == [None, None]
+        assert handle.alive() == [True, True]
+        assert not handle.done()
+        assert handle.outcome() is None
+        # The launcher-level poll() mirrors the current handle.
+        assert launcher.poll() == [None, None]
+        gate.write_text("go")
+        deadline = _time.time() + 30
+        while not handle.done() and _time.time() < deadline:
+            _time.sleep(0.02)
+        assert handle.poll() == [0, 0]
+        assert handle.outcome() == "ok"
+    finally:
+        handle.terminate()
+
+
+def test_handle_wait_and_crash_outcome(tmp_path):
+    launcher = JobLauncher(transport=LocalTransport(), tail_rank0=False)
+    handle = launcher.start(
+        _spec(2),
+        _py("import os, sys; "
+            "sys.exit(5 if os.environ['%s'] == '1' else 0)"
+            % ENV_PROCESS_ID),
+        str(tmp_path / "logs"))
+    codes = handle.wait(timeout_s=30)
+    handle.close()
+    assert codes == [0, 5]
+    assert handle.outcome() == "crash"
+    # Per-host logs landed in the usual attemptN-hostI layout.
+    assert sorted(p.name for p in (tmp_path / "logs").iterdir()) == [
+        "attempt0-host0.log", "attempt0-host1.log"]
+
+
+def test_handle_terminate_kills_running_hosts(tmp_path):
+    launcher = JobLauncher(transport=LocalTransport(), tail_rank0=False)
+    handle = launcher.start(
+        _spec(1), _py("import time; time.sleep(600)"),
+        str(tmp_path / "logs"))
+    assert handle.alive() == [True]
+    handle.terminate()
+    codes = handle.wait(timeout_s=30)
+    assert codes[0] is not None and codes[0] != 0
+
+
+def test_poll_without_start_returns_none(tmp_path):
+    assert JobLauncher(transport=LocalTransport()).poll() is None

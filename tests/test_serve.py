@@ -73,11 +73,30 @@ def test_queue_overload_is_explicit():
     with pytest.raises(OverloadError) as ei:
         q.submit([5, 2], 4)
     assert ei.value.depth == 2 and ei.value.max_depth == 2
-    # No admissions yet → no wait history → no hint, bare message.
-    assert ei.value.retry_after_s is None
+    # No admissions yet → no wait history → the cold-start floor stands
+    # in (a fleet router sheds on this number; None is not an answer).
+    assert ei.value.retry_after_s == RequestQueue.DEFAULT_RETRY_AFTER_FLOOR_S
+    assert "~0.050s" in str(ei.value)
     # Draining makes room again — bounded, not closed.
     q.pop_ready()
     q.submit([5, 2], 4)
+
+
+def test_queue_cold_start_retry_floor_is_configurable():
+    q = RequestQueue(max_depth=1, retry_after_floor_s=1.5)
+    q.submit([5, 2], 4)
+    with pytest.raises(OverloadError) as ei:
+        q.submit([5, 2], 4)
+    assert ei.value.retry_after_s == 1.5
+    # None disables the floor: the old hint-less cold start.
+    q2 = RequestQueue(max_depth=1, retry_after_floor_s=None)
+    q2.submit([5, 2], 4)
+    with pytest.raises(OverloadError) as ei:
+        q2.submit([5, 2], 4)
+    assert ei.value.retry_after_s is None
+    assert "retry later" in str(ei.value)
+    with pytest.raises(ValueError):
+        RequestQueue(retry_after_floor_s=-0.1)
 
 
 def test_overload_carries_retry_after_hint_from_queue_waits():
