@@ -201,6 +201,14 @@ def _finalize_green(record: dict, alive: bool, probe_note: str,
         record["value"] = None
         record["vs_baseline"] = None
         record["mfu"] = None
+        # Serving-scenario perf fields follow the same null-over-zero
+        # rule: an unmeasured run must not ship speculation/quantization
+        # numbers either. Only nulled when present so non-serving records
+        # keep their exact key set.
+        for key in ("spec_gamma", "spec_accept_rate",
+                    "tokens_per_target_step", "weight_bytes"):
+            if key in record:
+                record[key] = None
     return record
 
 

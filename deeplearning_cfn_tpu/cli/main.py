@@ -383,8 +383,21 @@ def _cmd_bench(args) -> int:
                                kv_blocks=args.kv_blocks,
                                prefix_cache=args.prefix_cache,
                                prefix_dup=args.prefix_dup,
+                               speculate=args.speculate,
+                               quantize=args.quantize,
                                smoke=args.smoke)
         print(json.dumps(line))
+        # The speculative contract is token-identity with plain greedy;
+        # a parity break is a correctness bug, not a perf datapoint —
+        # fail the run so CI gates on it (tools/t1.sh).
+        if line.get("token_identical") is False:
+            print("[dlcfn-tpu] speculative decode broke greedy token "
+                  "parity", file=sys.stderr)
+            return 1
+        if line.get("divergence_ok") is False:
+            print("[dlcfn-tpu] int8 logits divergence exceeded the "
+                  "bound", file=sys.stderr)
+            return 1
         return 0
     if getattr(args, "sweep_batches", None):
         if getattr(args, "ops", None) or args.collectives:
@@ -476,6 +489,7 @@ def _cmd_serve(args) -> int:
             decode_window=args.decode_window,
             kv_block_size=args.kv_block_size, kv_blocks=args.kv_blocks,
             prefix_cache_size=args.prefix_cache,
+            speculate_gamma=args.speculate, quantize=args.quantize,
             step=args.step, vocab=args.vocab, allow_init=args.allow_init)
     except (FileNotFoundError, ValueError) as e:
         print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
@@ -638,6 +652,8 @@ def _fleet_build_replicas(args, n: int):
             cfg, capacity=args.slots,
             default_max_new_tokens=args.max_new_tokens,
             decode_window=args.decode_window,
+            speculate_gamma=getattr(args, "speculate", 0),
+            quantize=getattr(args, "quantize", ""),
             vocab=args.vocab, allow_init=args.allow_init)
         replicas.append(EngineReplica(f"replica-{i}", engine))
     return replicas, bpe, at_step
@@ -720,6 +736,10 @@ def _cmd_fleet_up(args) -> int:
                 "--max-new-tokens", str(args.max_new_tokens),
                 "--decode-window", str(args.decode_window),
                 "--emit-every", str(args.emit_every)]
+        if getattr(args, "speculate", 0):
+            argv += ["--speculate", str(args.speculate)]
+        if getattr(args, "quantize", ""):
+            argv += ["--quantize", args.quantize]
         if args.accelerator:
             argv += ["--accelerator", args.accelerator]
         if args.vocab:
@@ -1431,6 +1451,15 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--prefix-cache", type=int, default=32,
                     help="encoder prefix-cache entries, keyed on the "
                          "padded source tokens (0 = disabled)")
+    sv.add_argument("--speculate", type=int, default=0,
+                    help="speculative decoding: draft tokens proposed per "
+                         "verify step (0 = off); self-draft without a "
+                         "separate draft checkpoint — greedy output stays "
+                         "token-identical either way")
+    sv.add_argument("--quantize", default="", choices=["", "int8"],
+                    help="weight-only quantization for serving (int8 = "
+                         "per-channel symmetric, ~4x smaller weights; "
+                         "checkpoints stay fp32 on disk)")
     sv.add_argument("--vocab", default="",
                     help="BPE vocab.json — required for \"text\" requests")
     sv.add_argument("--step", type=int, default=0,
@@ -1468,6 +1497,13 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("--max-new-tokens", type=int, default=64)
         p.add_argument("--decode-window", type=int, default=4,
                        help="fused decode steps per device call")
+        p.add_argument("--speculate", type=int, default=0,
+                       help="per-replica speculative decode draft depth "
+                            "(0 = off; self-draft)")
+        p.add_argument("--quantize", default="", choices=["", "int8"],
+                       help="per-replica weight-only quantization; "
+                            "rolling upgrades re-quantize the incoming "
+                            "fp32 checkpoint on swap")
         p.add_argument("--vocab", default="",
                        help="BPE vocab.json — required for \"text\" "
                             "requests")
@@ -1605,6 +1641,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="serving scenario: fraction of trace requests "
                          "repeating the first source — exercises the "
                          "prefix cache")
+    be.add_argument("--speculate", type=int, default=0,
+                    help="serving scenario: speculative decode draft "
+                         "depth γ (self-draft); the record gains "
+                         "spec_accept_rate / tokens_per_target_step and "
+                         "the run fails on a greedy-parity break")
+    be.add_argument("--quantize", default="", choices=["", "int8"],
+                    help="serving scenario: weight-only quantization; "
+                         "the record reports weight_bytes vs fp32 and a "
+                         "bounded logits-divergence check")
     be.add_argument("--smoke", action="store_true",
                     help="serving scenario: CI fast mode (few requests, "
                          "tiny budget, same record contract)")

@@ -37,6 +37,64 @@ TRANSFORMER_PARAM_RULES = (
 )
 
 
+class QuantDense(nn.Module):
+    """Weight-only int8 Dense: ``y = (x @ q) * scale + bias``.
+
+    Drop-in replacement for the decode-path ``nn.Dense`` layers when the
+    serve loader quantizes a checkpoint (serve/quant.py): ``kernel`` is the
+    int8 code tensor [in, out], ``scale`` the per-output-channel float32
+    dequant factor, ``bias`` unchanged float32. The dequant multiplies
+    AFTER the matmul — per-out-channel scales factor out of the contraction
+    — so the kernel stays int8 in HBM and is only widened to the activation
+    dtype inside the op (the LLM.int8/AWQ weight-only shape). Params are
+    produced by ``quantize_variables``, never trained, hence zeros init.
+    """
+
+    features: int
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.zeros,
+                            (x.shape[-1], self.features), jnp.int8)
+        scale = self.param("scale", nn.initializers.ones,
+                           (self.features,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        return y * scale.astype(self.dtype) + bias.astype(self.dtype)
+
+
+class QuantEmbed(nn.Module):
+    """Weight-only int8 embedding table with tied-output ``attend``.
+
+    Mirrors the ``nn.Embed`` surface the NMT embeddings use (lookup +
+    ``attend`` for the tied logits projection). ``scale`` is per-hidden-
+    channel [H], which serves both directions: lookup dequantizes the
+    gathered rows, attend folds the scale into the query so the [V, H]
+    table is never materialized in float.
+    """
+
+    num_embeddings: int
+    features: int
+
+    def setup(self):
+        self.embedding = self.param(
+            "embedding", nn.initializers.zeros,
+            (self.num_embeddings, self.features), jnp.int8)
+        self.scale = self.param("scale", nn.initializers.ones,
+                                (self.features,), jnp.float32)
+
+    def __call__(self, ids):
+        return jnp.take(self.embedding, ids, axis=0) \
+            .astype(jnp.float32) * self.scale
+
+    def attend(self, query):
+        # query @ (q * scale).T == (query * scale) @ q.T
+        return jnp.dot(query * self.scale.astype(query.dtype),
+                       self.embedding.astype(query.dtype).T)
+
+
 class MultiHeadAttention(nn.Module):
     """Self- or cross-attention over [B, S, H*D] activations.
 
@@ -75,6 +133,7 @@ class MultiHeadAttention(nn.Module):
     dtype: Dtype = jnp.bfloat16
     dropout_rate: float = 0.0
     attention_impl: str = "auto"
+    quantized: bool = False
 
     def core_attention(self, q, k, v, bias, causal):
         """The [B,H,S,D] attention op. Subclasses swap this for a
@@ -97,9 +156,13 @@ class MultiHeadAttention(nn.Module):
                 f"hidden size {features} not divisible by "
                 f"{self.num_heads} heads")
         head_dim = features // self.num_heads
-        dense = lambda name: nn.Dense(
-            features, dtype=self.dtype, param_dtype=jnp.float32, name=name,
-            kernel_init=nn.initializers.xavier_uniform())
+        if self.quantized:
+            dense = lambda name: QuantDense(features, dtype=self.dtype,
+                                            name=name)
+        else:
+            dense = lambda name: nn.Dense(
+                features, dtype=self.dtype, param_dtype=jnp.float32,
+                name=name, kernel_init=nn.initializers.xavier_uniform())
 
         def split(t):  # [B,S,F] -> [B,H,S,D]
             b, s, _ = t.shape
@@ -124,33 +187,66 @@ class MultiHeadAttention(nn.Module):
                                lambda: jnp.zeros(pool_shape, self.dtype))
             cv = self.variable("cache", "cached_value",
                                lambda: jnp.zeros(pool_shape, self.dtype))
+            max_blocks = block_tables.shape[1]
+            span = max_blocks * kv_block_size
+            s = q.shape[2]
             if is_initialized:
-                # Row b's single-position K/V land in its current block:
-                # pool[block_tables[b, pos // bs], :, pos % bs]. Rows whose
-                # table entry is unbound write into the null block 0 —
-                # masked below, never attended.
                 rows = jnp.arange(b)
-                blk = block_tables[rows, decode_pos // kv_block_size]
-                off = decode_pos % kv_block_size
-                ck.value = ck.value.at[blk, :, off, :].set(
-                    k[:, :, 0, :].astype(self.dtype))
-                cv.value = cv.value.at[blk, :, off, :].set(
-                    v[:, :, 0, :].astype(self.dtype))
+                if s == 1:
+                    # Row b's single-position K/V land in its current block:
+                    # pool[block_tables[b, pos // bs], :, pos % bs]. Rows
+                    # whose table entry is unbound write into the null block
+                    # 0 — masked below, never attended.
+                    blk = block_tables[rows, decode_pos // kv_block_size]
+                    off = decode_pos % kv_block_size
+                    ck.value = ck.value.at[blk, :, off, :].set(
+                        k[:, :, 0, :].astype(self.dtype))
+                    cv.value = cv.value.at[blk, :, off, :].set(
+                        v[:, :, 0, :].astype(self.dtype))
+                else:
+                    # Multi-position (speculative-verify) write: row b's s
+                    # K/V vectors land at logical positions pos[b]..pos[b]+
+                    # s-1. Positions past the bound span must NOT be routed
+                    # through a clipped table index (that would corrupt the
+                    # row's real last block) — they are redirected to the
+                    # null block 0 explicitly.
+                    pos_mat = decode_pos[:, None] + jnp.arange(s)  # [B, S]
+                    valid = pos_mat < span
+                    blk = jnp.where(
+                        valid,
+                        block_tables[rows[:, None],
+                                     jnp.minimum(pos_mat // kv_block_size,
+                                                 max_blocks - 1)],
+                        0)
+                    off = jnp.where(valid, pos_mat % kv_block_size, 0)
+                    # advanced indices at axes 0/2 put [B, S] first:
+                    # the update operand is k transposed to [B, S, H, D].
+                    ck.value = ck.value.at[blk, :, off, :].set(
+                        k.transpose(0, 2, 1, 3).astype(self.dtype))
+                    cv.value = cv.value.at[blk, :, off, :].set(
+                        v.transpose(0, 2, 1, 3).astype(self.dtype))
             # Gather each row's K/V span through its block table. The
             # gathered layout puts logical position p at index p, so with
             # span == max_decode_len this is bit-identical to the dense
             # per-row cache (masked positions contribute exactly 0).
-            max_blocks = block_tables.shape[1]
-            span = max_blocks * kv_block_size
 
             def gathered(c):
                 g = c[block_tables]  # [B, MB, H, bs, D]
                 return g.transpose(0, 2, 1, 3, 4).reshape(
                     b, self.num_heads, span, head_dim)
 
-            step_bias = jnp.where(
-                jnp.arange(span)[None, :] <= decode_pos[:, None],
-                0.0, -1e30)[:, None, None, :].astype(jnp.float32)
+            if s == 1:
+                step_bias = jnp.where(
+                    jnp.arange(span)[None, :] <= decode_pos[:, None],
+                    0.0, -1e30)[:, None, None, :].astype(jnp.float32)
+            else:
+                # Query j (logical position pos+j) sees cache positions
+                # <= pos+j: causal among the span's own freshly-written
+                # positions (write happens before the gather above).
+                pos_mat = decode_pos[:, None] + jnp.arange(s)
+                step_bias = jnp.where(
+                    jnp.arange(span)[None, None, :] <= pos_mat[:, :, None],
+                    0.0, -1e30)[:, None, :, :].astype(jnp.float32)
             out = fused_attention(q, gathered(ck.value),
                                   gathered(cv.value), bias=step_bias,
                                   causal=False, implementation="reference")
@@ -177,7 +273,7 @@ class MultiHeadAttention(nn.Module):
                     cv.value = jax.lax.dynamic_update_slice(
                         cv.value, v.astype(self.dtype), (0, 0, idx, 0))
                     ci.value = idx + 1
-                else:
+                elif k.shape[2] == 1:
                     # Per-row write: row b's single-position K/V land at
                     # decode_pos[b]. cache_index is left untouched — the
                     # caller (serve/engine.py) owns per-row positions.
@@ -186,6 +282,17 @@ class MultiHeadAttention(nn.Module):
                         k[:, :, 0, :].astype(self.dtype))
                     cv.value = cv.value.at[rows, :, decode_pos, :].set(
                         v[:, :, 0, :].astype(self.dtype))
+                else:
+                    # Multi-position (speculative-verify) write: row b's s
+                    # K/V vectors land at decode_pos[b]..decode_pos[b]+s-1.
+                    # Out-of-range positions are dropped by the scatter.
+                    rows = jnp.arange(b)
+                    pos_mat = decode_pos[:, None] + \
+                        jnp.arange(k.shape[2])  # [B, S]
+                    ck.value = ck.value.at[rows[:, None], :, pos_mat, :].set(
+                        k.transpose(0, 2, 1, 3).astype(self.dtype))
+                    cv.value = cv.value.at[rows[:, None], :, pos_mat, :].set(
+                        v.transpose(0, 2, 1, 3).astype(self.dtype))
             # Attend only to filled positions (<= the row's position). The
             # single-query step is tiny — the jnp reference path, not the
             # Pallas kernel, is the right tool.
@@ -193,11 +300,18 @@ class MultiHeadAttention(nn.Module):
                 step_bias = jnp.where(
                     jnp.arange(max_decode_len) <= idx, 0.0, -1e30
                 )[None, None, None, :].astype(jnp.float32)
-            else:
+            elif q.shape[2] == 1:
                 step_bias = jnp.where(
                     jnp.arange(max_decode_len)[None, :]
                     <= decode_pos[:, None], 0.0, -1e30
                 )[:, None, None, :].astype(jnp.float32)
+            else:
+                # Span bias [B, 1, S, L]: query j attends to <= pos + j.
+                pos_mat = decode_pos[:, None] + jnp.arange(q.shape[2])
+                step_bias = jnp.where(
+                    jnp.arange(max_decode_len)[None, None, :]
+                    <= pos_mat[:, :, None], 0.0, -1e30
+                )[:, None, :, :].astype(jnp.float32)
             out = fused_attention(q, ck.value, cv.value, bias=step_bias,
                                   causal=False, implementation="reference")
         else:
@@ -216,17 +330,21 @@ class Mlp(nn.Module):
     dtype: Dtype = jnp.bfloat16
     dropout_rate: float = 0.0
     act: Callable = nn.gelu
+    quantized: bool = False
 
     @nn.compact
     def __call__(self, x, deterministic=True):
         features = x.shape[-1]
-        y = nn.Dense(self.mlp_dim, dtype=self.dtype,
-                     param_dtype=jnp.float32, name="mlp_in",
-                     kernel_init=nn.initializers.xavier_uniform())(x)
+        if self.quantized:
+            dense = lambda feats, name: QuantDense(feats, dtype=self.dtype,
+                                                   name=name)
+        else:
+            dense = lambda feats, name: nn.Dense(
+                feats, dtype=self.dtype, param_dtype=jnp.float32, name=name,
+                kernel_init=nn.initializers.xavier_uniform())
+        y = dense(self.mlp_dim, "mlp_in")(x)
         y = self.act(y)
-        y = nn.Dense(features, dtype=self.dtype, param_dtype=jnp.float32,
-                     name="mlp_out",
-                     kernel_init=nn.initializers.xavier_uniform())(y)
+        y = dense(features, "mlp_out")(y)
         if self.dropout_rate > 0:
             y = nn.Dropout(self.dropout_rate)(y, deterministic=deterministic)
         return y
@@ -254,6 +372,7 @@ class TransformerLayer(nn.Module):
     num_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_top_k: int = 2
+    quantized: bool = False
 
     @nn.compact
     def __call__(self, x, enc=None, self_bias=None, cross_bias=None,
@@ -265,7 +384,7 @@ class TransformerLayer(nn.Module):
             dtype=self.dtype, param_dtype=jnp.float32, name=name)
         attn = lambda name: MultiHeadAttention(
             self.num_heads, self.dtype, self.dropout_rate,
-            self.attention_impl, name=name)
+            self.attention_impl, quantized=self.quantized, name=name)
 
         def residual(x, sub, name):
             if self.prenorm:
@@ -309,6 +428,7 @@ class TransformerLayer(nn.Module):
             return x, aux_box
         x = residual(
             x, lambda y: Mlp(self.mlp_dim, self.dtype, self.dropout_rate,
+                             quantized=self.quantized,
                              name="mlp")(y, deterministic=deterministic),
             "mlp")
         return x

@@ -26,8 +26,8 @@ import jax
 import jax.numpy as jnp
 
 from . import register_model
-from .transformer import TRANSFORMER_PARAM_RULES, TransformerLayer, \
-    padding_bias
+from .transformer import QuantEmbed, TRANSFORMER_PARAM_RULES, \
+    TransformerLayer, padding_bias
 
 PARAM_RULES = TRANSFORMER_PARAM_RULES
 
@@ -41,11 +41,15 @@ class NmtEmbeddings(nn.Module):
     max_len: int
     dtype: Any = jnp.bfloat16
     dropout_rate: float = 0.0
+    quantized: bool = False
 
     def setup(self):
-        self.token = nn.Embed(self.vocab_size, self.hidden_size,
-                              param_dtype=jnp.float32,
-                              embedding_init=nn.initializers.normal(0.02))
+        if self.quantized:
+            self.token = QuantEmbed(self.vocab_size, self.hidden_size)
+        else:
+            self.token = nn.Embed(
+                self.vocab_size, self.hidden_size, param_dtype=jnp.float32,
+                embedding_init=nn.initializers.normal(0.02))
         self.src_position = self.param(
             "src_position", nn.initializers.normal(0.02),
             (self.max_len, self.hidden_size), jnp.float32)
@@ -86,15 +90,16 @@ class TransformerNMT(nn.Module):
     dtype: Any = jnp.bfloat16
     dropout_rate: float = 0.0
     attention_impl: str = "auto"
+    quantized: bool = False
 
     def setup(self):
         self.embed = NmtEmbeddings(
             self.vocab_size, self.hidden_size, self.max_len, self.dtype,
-            self.dropout_rate)
+            self.dropout_rate, quantized=self.quantized)
         layer = lambda cross: TransformerLayer(
             self.num_heads, self.mlp_dim, self.dtype, self.dropout_rate,
             prenorm=True, cross_attention=cross,
-            attention_impl=self.attention_impl)
+            attention_impl=self.attention_impl, quantized=self.quantized)
         self.enc = [layer(False) for _ in range(self.num_layers)]
         self.enc_norm = nn.LayerNorm(dtype=self.dtype,
                                      param_dtype=jnp.float32)
@@ -186,6 +191,63 @@ class TransformerNMT(nn.Module):
         """
         pos_emb = jnp.take(self.embed.tgt_position, pos, axis=0)  # [B, H]
         y = self.embed.token(tgt_id) + pos_emb[:, None, :]
+        y = self.embed.tgt_norm(y.astype(self.dtype))
+        cross_bias = padding_bias(src_mask)
+        for lyr in self.dec:
+            y = lyr(y, enc=enc, cross_bias=cross_bias, causal=True,
+                    deterministic=True, decode=True,
+                    max_decode_len=self.max_len, decode_pos=pos,
+                    block_tables=block_tables, kv_num_blocks=num_blocks,
+                    kv_block_size=block_size)
+        y = self.dec_norm(y)
+        return self.embed.logits(y)
+
+    def decode_span_at(self, tgt_ids, enc, src_mask, pos):
+        """Multi-position decode for speculative verification: score S
+        query positions per row in ONE apply.
+
+        ``tgt_ids`` [B, S] are the tokens at positions ``pos[b]`` ..
+        ``pos[b] + S - 1`` (the previous committed token followed by the
+        draft's proposals); returns logits [B, S, V] where row slice j is
+        the target distribution for position ``pos[b] + j + 1``. Every
+        decoder layer writes all S K/V vectors into the per-row cache
+        BEFORE attending, and the span bias keeps query j causal (sees
+        cache positions <= pos + j only), so slice j is numerically
+        identical to what S sequential :meth:`decode_step_at` calls would
+        have produced — the property that makes accept-prefix speculation
+        token-identical to plain greedy. Positions past ``max_len`` are
+        dropped by the scatter and their logits are garbage; callers must
+        never emit from them (serve/engine.py clamps to the row budget
+        first).
+        """
+        s = tgt_ids.shape[1]
+        pos_mat = jnp.minimum(pos[:, None] + jnp.arange(s),
+                              self.max_len - 1)
+        pos_emb = jnp.take(self.embed.tgt_position, pos_mat,
+                           axis=0)  # [B, S, H]
+        y = self.embed.token(tgt_ids) + pos_emb
+        y = self.embed.tgt_norm(y.astype(self.dtype))
+        cross_bias = padding_bias(src_mask)
+        for lyr in self.dec:
+            y = lyr(y, enc=enc, cross_bias=cross_bias, causal=True,
+                    deterministic=True, decode=True,
+                    max_decode_len=self.max_len, decode_pos=pos)
+        y = self.dec_norm(y)
+        return self.embed.logits(y)
+
+    def decode_span_paged(self, tgt_ids, enc, src_mask, pos, block_tables,
+                          *, num_blocks: int, block_size: int):
+        """Paged-KV form of :meth:`decode_span_at`: the S-position write
+        routes each logical position through the row's block table
+        (overflow positions land in the null block 0), then all S queries
+        attend the gathered span in one apply. Same cache layout as
+        :meth:`decode_step_paged`, so the speculative verify step and the
+        plain fused window share one block pool."""
+        s = tgt_ids.shape[1]
+        pos_mat = jnp.minimum(pos[:, None] + jnp.arange(s),
+                              self.max_len - 1)
+        pos_emb = jnp.take(self.embed.tgt_position, pos_mat, axis=0)
+        y = self.embed.token(tgt_ids) + pos_emb
         y = self.embed.tgt_norm(y.astype(self.dtype))
         cross_bias = padding_bias(src_mask)
         for lyr in self.dec:

@@ -14,6 +14,8 @@ KV-cache decoder machinery (models/decoding.py, models/transformer_nmt.py):
 - :mod:`.queue` — bounded request lifecycle (submit/poll/cancel, deadlines,
   explicit overload rejection);
 - :mod:`.loader` — checkpoint restore + tokenizer binding;
+- :mod:`.quant` — weight-only int8 checkpoint quantization for the
+  ``--quantize int8`` serving mode;
 - :mod:`.metrics` — queue depth / TTFT / tokens-per-sec / slot occupancy
   through metrics/jsonl.py;
 - :mod:`.bench` — the fixed-trace serving benchmark scenario.
@@ -25,6 +27,11 @@ from .blockpool import BlockAllocator, BlockPoolExhausted  # noqa: F401
 from .engine import Engine  # noqa: F401
 from .metrics import ServeMetrics, percentile  # noqa: F401
 from .prefix import PrefixCache  # noqa: F401
+from .quant import (  # noqa: F401
+    quantize_variables,
+    quantized_model,
+    variables_bytes,
+)
 from .queue import (  # noqa: F401
     OverloadError,
     Request,

@@ -55,48 +55,10 @@ def _fixed_trace(num_requests: int, src_len: int, vocab_size: int,
     return trace
 
 
-def run_serve_bench(num_requests: int = 16, slots: int = 4,
-                    max_new_tokens: int = 16, beam_size: int = 1,
-                    src_len: int = 12, seed: int = 0,
-                    decode_window: int = DEFAULT_DECODE_WINDOW,
-                    kv_block_size: int = 16, kv_blocks: int = 0,
-                    prefix_cache: int = 16, prefix_dup: float = 0.0,
-                    smoke: bool = False) -> Dict:
-    """Run the fixed trace to drain; return the BENCH-contract record.
-
-    ``smoke=True`` shrinks the scenario to a few tiny requests — the CI
-    mode that keeps the serving bench (and its record contract) exercised
-    on every round without measurable cost.
-    """
-    import jax
-
-    from ..models.transformer_nmt import transformer_nmt_tiny
-
-    if smoke:
-        num_requests, slots = min(num_requests, 4), min(slots, 2)
-        max_new_tokens, src_len = min(max_new_tokens, 4), min(src_len, 8)
-
-    model = transformer_nmt_tiny(vocab_size=96, max_len=64)
-    variables = model.init(
-        jax.random.PRNGKey(seed),
-        np.zeros((1, src_len), np.int32), np.ones((1, src_len), np.int32),
-        np.zeros((1, src_len), np.int32), train=False)
-    engine = Engine(model, {"params": variables["params"]}, capacity=slots,
-                    max_src_len=src_len, queue_depth=num_requests,
-                    default_max_new_tokens=max_new_tokens,
-                    decode_window=decode_window,
-                    kv_block_size=kv_block_size, kv_blocks=kv_blocks,
-                    prefix_cache_size=prefix_cache)
-    trace = _fixed_trace(num_requests, src_len, 96, seed=seed,
-                         prefix_dup=prefix_dup)
-    # Warmup outside the timed window: compiles the encoder, the fused
-    # decode window (or the logits step for beam), and the admit scatter.
-    engine.submit(trace[0], max_new_tokens=min(2, max_new_tokens),
-                  beam_size=beam_size)
-    engine.run_until_drained()
-    warmup_tokens = engine.metrics.tokens_generated
-
-    t0 = time.monotonic()
+def _drain_trace(engine: Engine, trace, max_new_tokens: int,
+                 beam_size: int):
+    """Submit every trace request (stepping through backpressure) and run
+    the engine to drain; returns (request ids, engine ticks)."""
     ids = []
     for src in trace:
         while True:
@@ -108,12 +70,112 @@ def run_serve_bench(num_requests: int = 16, slots: int = 4,
             except OverloadError:
                 engine.step()  # backpressure: make room, then retry
     ticks = engine.run_until_drained()
+    return ids, ticks
+
+
+def _quant_divergence(model, fp32_variables, src_len: int,
+                      vocab_size: int, seed: int):
+    """Bounded logits-divergence check for int8 weight-only serving: one
+    forward pass fp32 vs quantized on a fixed seeded batch. Returns
+    (max_abs_diff, bound, ok) — the bound is relative to the fp32 logit
+    scale, so the check tracks the model rather than a magic constant."""
+    import jax.numpy as jnp
+
+    from .quant import quantize_variables, quantized_model
+
+    rng = np.random.RandomState(seed + 1)
+    src = rng.randint(3, vocab_size, size=(2, src_len)).astype(np.int32)
+    mask = np.ones((2, src_len), np.int32)
+    tgt = rng.randint(3, vocab_size, size=(2, src_len)).astype(np.int32)
+    ref = model.apply(fp32_variables, src, mask, tgt, train=False)
+    q = quantized_model(model).apply(
+        quantize_variables(fp32_variables), src, mask, tgt, train=False)
+    diff = float(jnp.max(jnp.abs(q.astype(jnp.float32)
+                                 - ref.astype(jnp.float32))))
+    bound = 0.1 * max(1.0, float(jnp.max(jnp.abs(ref))))
+    return diff, bound, diff <= bound
+
+
+def run_serve_bench(num_requests: int = 16, slots: int = 4,
+                    max_new_tokens: int = 16, beam_size: int = 1,
+                    src_len: int = 12, seed: int = 0,
+                    decode_window: int = DEFAULT_DECODE_WINDOW,
+                    kv_block_size: int = 16, kv_blocks: int = 0,
+                    prefix_cache: int = 16, prefix_dup: float = 0.0,
+                    speculate: int = 0, quantize: str = "",
+                    smoke: bool = False) -> Dict:
+    """Run the fixed trace to drain; return the BENCH-contract record.
+
+    ``smoke=True`` shrinks the scenario to a few tiny requests — the CI
+    mode that keeps the serving bench (and its record contract) exercised
+    on every round without measurable cost. ``speculate=γ`` turns on
+    self-draft speculative decoding and re-runs the same trace through a
+    plain-greedy reference engine to assert the token-identical contract
+    (``token_identical`` in the record — the t1 gate fails the build on a
+    parity break). ``quantize="int8"`` serves weight-only int8 and reports
+    the weight/KV HBM footprint next to fp32 plus a bounded
+    logits-divergence check.
+    """
+    import jax
+
+    from ..models.transformer_nmt import transformer_nmt_tiny
+    from .quant import variables_bytes
+
+    if smoke:
+        num_requests, slots = min(num_requests, 4), min(slots, 2)
+        max_new_tokens, src_len = min(max_new_tokens, 4), min(src_len, 8)
+
+    model = transformer_nmt_tiny(vocab_size=96, max_len=64)
+    variables = model.init(
+        jax.random.PRNGKey(seed),
+        np.zeros((1, src_len), np.int32), np.ones((1, src_len), np.int32),
+        np.zeros((1, src_len), np.int32), train=False)
+    fp32_variables = {"params": variables["params"]}
+    engine_kwargs = dict(
+        capacity=slots, max_src_len=src_len, queue_depth=num_requests,
+        default_max_new_tokens=max_new_tokens,
+        decode_window=decode_window, kv_block_size=kv_block_size,
+        kv_blocks=kv_blocks, prefix_cache_size=prefix_cache,
+        quantize=quantize)
+    engine = Engine(model, fp32_variables,
+                    speculate_gamma=speculate, **engine_kwargs)
+    trace = _fixed_trace(num_requests, src_len, 96, seed=seed,
+                         prefix_dup=prefix_dup)
+    # Warmup outside the timed window: compiles the encoder, the fused
+    # decode window (or the logits step for beam), and the admit scatter.
+    engine.submit(trace[0], max_new_tokens=min(2, max_new_tokens),
+                  beam_size=beam_size)
+    engine.run_until_drained()
+    warmup_tokens = engine.metrics.tokens_generated
+
+    t0 = time.monotonic()
+    ids, ticks = _drain_trace(engine, trace, max_new_tokens, beam_size)
     elapsed = time.monotonic() - t0
+
+    # The speculative contract is "token-identical to plain greedy": rerun
+    # the identical trace through a reference engine with speculation off
+    # (same quantization, so parity is apples-to-apples) and compare every
+    # request's tokens. Outside the timed window — it's a check, not work.
+    token_identical = None
+    if speculate > 0 and beam_size == 1:
+        ref = Engine(model, fp32_variables, speculate_gamma=0,
+                     **engine_kwargs)
+        ref_ids, _ = _drain_trace(ref, trace, max_new_tokens, beam_size)
+        token_identical = all(
+            engine.poll(i).tokens == ref.poll(ri).tokens
+            for i, ri in zip(ids, ref_ids))
+
+    divergence = bound = divergence_ok = None
+    if quantize:
+        divergence, bound, divergence_ok = _quant_divergence(
+            model, fp32_variables, src_len, 96, seed)
 
     lat = [engine.poll(i).latency_s for i in ids
            if engine.poll(i).latency_s is not None]
     m = engine.metrics
     toks = m.tokens_generated - warmup_tokens  # minus the warmup request
+    kv_bytes = int(sum(np.asarray(leaf).nbytes for leaf in
+                       jax.tree_util.tree_leaves(engine.cache)))
     return {
         "metric": METRIC,
         "value": round(toks / elapsed, 2) if elapsed > 0 else None,
@@ -146,5 +208,20 @@ def run_serve_bench(num_requests: int = 16, slots: int = 4,
         "prefix_hit_rate": m.prefix_hit_rate,
         "encoder_invocations": engine.encoder_invocations,
         "admitted": m.admitted,
+        "spec_gamma": speculate,
+        "spec_accept_rate": None if m.spec_accept_rate is None
+        else round(m.spec_accept_rate, 4),
+        "tokens_per_target_step": None
+        if m.spec_tokens_per_target_step is None
+        else round(m.spec_tokens_per_target_step, 4),
+        "token_identical": token_identical,
+        "quantize": quantize,
+        "weight_bytes": variables_bytes(engine.variables),
+        "weight_bytes_fp32": variables_bytes(fp32_variables),
+        "kv_bytes": kv_bytes,
+        "logits_divergence": None if divergence is None
+        else round(divergence, 6),
+        "divergence_bound": None if bound is None else round(bound, 6),
+        "divergence_ok": divergence_ok,
         "device": jax.default_backend(),
     }

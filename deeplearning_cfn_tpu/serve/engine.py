@@ -139,6 +139,10 @@ class Engine:
                  kv_block_size: int = 0,
                  kv_blocks: int = 0,
                  prefix_cache_size: int = 0,
+                 speculate_gamma: int = 0,
+                 draft_model=None,
+                 draft_variables=None,
+                 quantize: str = "",
                  clock=time.monotonic,
                  metrics: Optional[ServeMetrics] = None,
                  retry_after_floor_s: Optional[float]
@@ -148,6 +152,24 @@ class Engine:
         if decode_window <= 0:
             raise ValueError(
                 f"decode_window must be positive, got {decode_window}")
+        if speculate_gamma < 0:
+            raise ValueError(
+                f"speculate_gamma must be >= 0, got {speculate_gamma}")
+        # Int8 weight-only quantization happens HERE, not in the loader:
+        # the engine owns the (model clone, quantized params) pairing, so
+        # swap_variables can re-quantize an incoming fp32 checkpoint and
+        # fleet rollouts keep working against a quantized serving fleet.
+        self.quantize = str(quantize or "")
+        if self.quantize:
+            from .quant import quantize_variables, quantized_model
+
+            model = quantized_model(model)
+            variables = quantize_variables(variables, self.quantize)
+            if draft_model is not None:
+                draft_model = quantized_model(draft_model)
+                if draft_variables is not None:
+                    draft_variables = quantize_variables(
+                        draft_variables, self.quantize)
         self.model = model
         self.variables = variables
         self.capacity = capacity
@@ -169,6 +191,46 @@ class Engine:
                                   retry_after_floor_s=retry_after_floor_s)
         self.metrics = metrics if metrics is not None \
             else ServeMetrics(capacity, clock=clock)
+
+        # Speculative decoding (Leviathan et al.): a draft model proposes
+        # speculate_gamma tokens per row autoregressively, the target
+        # verifies all of them in ONE multi-position apply, and the
+        # accept-prefix rule keeps greedy output token-identical to the
+        # plain path. With no draft_model the target drafts for itself
+        # ("self-draft") — acceptance is then total by construction, which
+        # is the γ+1-tokens-per-target-step upper bound and the CI smoke's
+        # configuration; a real deployment loads a shrunk checkpoint.
+        self.speculate_gamma = int(speculate_gamma)
+        self._self_draft = draft_model is None
+        if self.speculate_gamma > 0:
+            if draft_model is None:
+                self.draft_model = self.model
+                self.draft_variables = self.variables
+            else:
+                if draft_variables is None:
+                    raise ValueError(
+                        "draft_model needs draft_variables")
+                self.draft_model = draft_model
+                self.draft_variables = draft_variables
+            draft_max_len = int(getattr(self.draft_model, "max_len", 0)
+                                or 0)
+            if draft_max_len < self.model_max_len:
+                raise ValueError(
+                    f"draft max_len {draft_max_len} is shorter than the "
+                    f"target's {self.model_max_len} — the draft must be "
+                    f"able to reach every target position")
+            draft_vocab = int(getattr(self.draft_model, "vocab_size", 0)
+                              or 0)
+            tgt_vocab = int(getattr(model, "vocab_size", 0) or 0)
+            if draft_vocab != tgt_vocab:
+                raise ValueError(
+                    f"draft vocab_size {draft_vocab} != target's "
+                    f"{tgt_vocab} — proposals would not be comparable")
+            self.metrics.configure_speculation(self.speculate_gamma)
+        else:
+            self.draft_model = None
+            self.draft_variables = None
+        self._spec_fn_cached = None
 
         # Paged-KV configuration. The divisibility requirement is what
         # makes the paged step bit-identical to the dense one: the gathered
@@ -304,6 +366,37 @@ class Engine:
         self._row_owner: List[Optional[str]] = [None] * cap
         self._groups: List[_Group] = []
 
+        # Draft-side device state. The draft cache is always a dense
+        # [capacity, H, max_len, D] row table (a shrunk draft is small —
+        # paging it buys little and would double the allocator surface).
+        # Self-draft shares the target's encoder tables (_enc_d = None);
+        # a distinct draft gets its own encoder output table, refreshed by
+        # the same batched admission prefill.
+        self._draft_cache = None
+        self._enc_d = None
+        self._encode_draft_fn = None
+        self._admit_scatter1_fn = None
+        if self.speculate_gamma > 0:
+            dm, dmcls = self.draft_model, type(self.draft_model)
+            if self._self_draft:
+                draft_enc = self._enc
+            else:
+                self._encode_draft_fn = jax.jit(
+                    lambda v, src, mask: dm.apply(v, src, mask,
+                                                  method=dmcls.encode))
+                enc1d = self._encode_draft_fn(self.draft_variables,
+                                              dummy_src, dummy_mask)
+                self._enc_d = jnp.zeros((cap, s, enc1d.shape[-1]),
+                                        enc1d.dtype)
+                self._admit_scatter1_fn = jax.jit(
+                    lambda t, new, rows: t.at[rows].set(new),
+                    donate_argnums=(0,))
+                draft_enc = self._enc_d
+            self._draft_cache = dm.init(
+                jax.random.PRNGKey(0), jnp.zeros((cap, 1), jnp.int32),
+                draft_enc, self._src_mask, jnp.zeros((cap,), jnp.int32),
+                method=dmcls.decode_step_at)["cache"]
+
     # -- client surface ----------------------------------------------------
 
     def submit(self, src_ids: List[int],
@@ -366,7 +459,17 @@ class Engine:
                 f"swap_variables requires an idle engine "
                 f"({len(self._groups)} running, {self.queue.depth} queued) "
                 f"— drain first")
+        if self.quantize:
+            # The engine serves a quantized model clone, so an incoming
+            # fp32 checkpoint must be re-quantized here — otherwise fleet
+            # rollout against a --quantize int8 fleet would apply float
+            # params to int8-shaped modules.
+            from .quant import quantize_variables
+
+            variables = quantize_variables(variables, self.quantize)
         self.variables = variables
+        if self.speculate_gamma > 0 and self._self_draft:
+            self.draft_variables = self.variables
         if self._prefix is not None:
             self._prefix = PrefixCache(self._prefix.max_entries)
 
@@ -580,6 +683,7 @@ class Engine:
             self._enc, self._src_mask = self._admit_scatter_fn(
                 self._enc, self._src_mask, enc_new, jnp.asarray(mask),
                 jnp.asarray(row_targets))
+            self._draft_prefill(src, mask, row_targets)
             return
         # Prefix-cached prefill: sources are keyed on their padded token
         # tuple (the exact encoder input, so a hit is bit-identical to
@@ -617,6 +721,21 @@ class Engine:
         self._enc, self._src_mask = self._admit_scatter_fn(
             self._enc, self._src_mask, jnp.asarray(buffer),
             jnp.asarray(mask), jnp.asarray(row_targets))
+        self._draft_prefill(src, mask, row_targets)
+
+    def _draft_prefill(self, src, mask, row_targets) -> None:
+        """Distinct-draft admission prefill: the draft encoder runs over
+        the same padded admit batch and scatters into its own encoder
+        table (the source mask is shared with the target). Self-draft
+        aliases the target tables, so there is nothing to refresh — the
+        draft's encoder outputs are never prefix-cached (the draft is
+        small; caching buys target-encoder work only)."""
+        if self._enc_d is None:
+            return
+        enc_new = self._encode_draft_fn(self.draft_variables,
+                                        jnp.asarray(src), jnp.asarray(mask))
+        self._enc_d = self._admit_scatter1_fn(
+            self._enc_d, enc_new, jnp.asarray(row_targets))
 
     def _beam_select(self, w: int):
         """Jitted per-group candidate selection — the same f32 log-softmax
@@ -725,6 +844,155 @@ class Engine:
             return 1
         return self.decode_window
 
+    # -- the speculative window --------------------------------------------
+
+    def _spec_fn(self):
+        """Jitted speculative window: γ+1 draft ``greedy_step_at`` scan
+        iterations followed by ONE target multi-position verify
+        (``decode_span_at`` / ``decode_span_paged``).
+
+        The draft scan runs γ+1 steps, not γ: it feeds ``prev`` then each
+        of its own proposals, so the draft cache ends the call with K/V
+        written at every position ``pos .. pos+γ`` — including the bonus
+        position a fully-accepted window commits — and the first draft
+        write of the NEXT call overwrites the one position whose token the
+        target corrected (write-before-attend, the same discipline row
+        recycling relies on). Only the last scan output (the would-be
+        γ+1'th proposal) is discarded. The target apply scores all γ+1
+        query positions in one batched step and returns per-position
+        argmax ids — the whole accept/emit decision needs only
+        [capacity, 2γ+1] int32 on the host, never logits.
+        """
+        if self._spec_fn_cached is not None:
+            return self._spec_fn_cached
+        model, mcls = self.model, type(self.model)
+        dmodel, dmcls = self.draft_model, type(self.draft_model)
+        gamma = self.speculate_gamma
+        max_len = self.model_max_len
+        nb, bs = self.kv_blocks, self.kv_block_size
+
+        def draft_scan(vd, dcache, prev, pos, active, enc_d, src_mask):
+            def body(carry, _):
+                dcache, dprev, dpos = carry
+                nxt, mut = dmodel.apply(
+                    {**vd, "cache": dcache}, dprev[:, None], enc_d,
+                    src_mask, dpos, method=dmcls.greedy_step_at,
+                    mutable=["cache"])
+                dcache = mut["cache"]
+                dprev = jnp.where(active, nxt, PAD_ID)
+                dpos = jnp.minimum(dpos + active.astype(jnp.int32),
+                                   max_len - 1)
+                return (dcache, dprev, dpos), dprev
+
+            (dcache, _, _), drafts = jax.lax.scan(
+                body, (dcache, prev, pos), None, length=gamma + 1)
+            return dcache, drafts[:gamma].T  # proposals [capacity, γ]
+
+        if self.paged:
+            def spec(v, vd, cache, dcache, prev, pos, active, enc,
+                     src_mask, enc_d, tables):
+                dcache, props = draft_scan(vd, dcache, prev, pos, active,
+                                           enc_d, src_mask)
+                tgt_in = jnp.concatenate([prev[:, None], props], axis=1)
+                logits, mut = model.apply(
+                    {**v, "cache": cache}, tgt_in, enc, src_mask, pos,
+                    tables, num_blocks=nb, block_size=bs,
+                    method=mcls.decode_span_paged, mutable=["cache"])
+                tgt = jnp.argmax(logits.astype(jnp.float32),
+                                 axis=-1).astype(jnp.int32)
+                return props, tgt, mut["cache"], dcache
+        else:
+            def spec(v, vd, cache, dcache, prev, pos, active, enc,
+                     src_mask, enc_d):
+                dcache, props = draft_scan(vd, dcache, prev, pos, active,
+                                           enc_d, src_mask)
+                tgt_in = jnp.concatenate([prev[:, None], props], axis=1)
+                logits, mut = model.apply(
+                    {**v, "cache": cache}, tgt_in, enc, src_mask, pos,
+                    method=mcls.decode_span_at, mutable=["cache"])
+                tgt = jnp.argmax(logits.astype(jnp.float32),
+                                 axis=-1).astype(jnp.int32)
+                return props, tgt, mut["cache"], dcache
+
+        self._spec_fn_cached = jax.jit(spec, donate_argnums=(2, 3))
+        return self._spec_fn_cached
+
+    def _spec_step(self) -> int:
+        """One speculative tick: draft proposes γ, target verifies in one
+        step, the host emits the longest accepted prefix plus the target's
+        correction token — token-identical to plain greedy by the span-vs-
+        sequential identity of decode_span_at (tested). EOS, budget, and
+        cache exhaustion are enforced token-by-token exactly as the fused
+        window body does, truncating the rest of the window."""
+        cap = self.capacity
+        gamma = self.speculate_gamma
+        active = np.zeros((cap,), bool)
+        for g in self._groups:
+            active[g.rows[0]] = True
+        if self.paged:
+            # The verify step writes positions pos .. pos+γ, so bind
+            # blocks for a γ+1-token advance (clamped to each row's
+            # budget; overflow writes land in the null block).
+            self._bind_rows(gamma + 1)
+        kv_in_use = self.allocator.blocks_in_use if self.paged else None
+        t0 = self._clock()
+        args = (self.variables, self.draft_variables, self.cache,
+                self._draft_cache, jnp.asarray(self._prev),
+                jnp.asarray(self._pos), jnp.asarray(active), self._enc,
+                self._src_mask,
+                self._enc if self._enc_d is None else self._enc_d)
+        if self.paged:
+            args += (jnp.asarray(self._block_tables),)
+        props, tgt, self.cache, self._draft_cache = self._spec_fn()(*args)
+        # Host traffic: [capacity, γ] proposals + [capacity, γ+1] target
+        # ids — the accept loop below needs nothing else.
+        props = np.asarray(props)
+        tgt = np.asarray(tgt)
+        dt = self._clock() - t0
+        # Post-speculation decode latency: the queue's overload hint
+        # recomputes from this window when wait samples are missing, so
+        # retry-after reflects speculative throughput, not the static
+        # floor.
+        self.queue.note_decode_window(dt)
+        now = self._clock()
+        new_tokens = 0
+        rows_active = 0
+        accepted_total = 0
+        rates: List[float] = []
+        for g in list(self._groups):
+            r = g.rows[0]
+            rows_active += 1
+            a = 0
+            while a < gamma and props[r, a] == tgt[r, a]:
+                a += 1
+            accepted_total += a
+            rates.append(a / gamma)
+            done = False
+            for j in range(a + 1):
+                tok = int(tgt[r, j])
+                g.req.tokens.append(tok)
+                g.steps += 1
+                new_tokens += 1
+                if g.req.first_token_at is None:
+                    g.req.first_token_at = now
+                    self.metrics.record_first_token(g.req.ttft_s)
+                new_pos = int(self._pos[r]) + 1
+                exhausted = new_pos >= self.model_max_len
+                self._pos[r] = min(new_pos, self.model_max_len - 1)
+                self._prev[r] = tok
+                if tok == EOS_ID or g.steps >= g.budget or exhausted:
+                    done = True
+                    break
+            if done:
+                self._release(g, RequestState.DONE, now)
+        self.metrics.record_step(
+            rows_active, self.queue.depth, new_tokens, dt, steps=1,
+            kv_blocks_in_use=kv_in_use)
+        self.metrics.record_spec(
+            proposed=gamma * rows_active, accepted=accepted_total,
+            target_row_steps=rows_active, emitted=new_tokens, rates=rates)
+        return 1
+
     # -- the step ----------------------------------------------------------
 
     def step(self) -> int:
@@ -751,6 +1019,18 @@ class Engine:
             with span("serve.decode", path="host", k=1,
                       request_ids=active_ids):
                 return self._host_step()
+        # Speculate only when the tick is pure greedy with no deadlines:
+        # beams need per-step host top-k (handled above), and a pending
+        # deadline must be able to expire within one plain step — the
+        # spec window advances up to γ+1 positions per call, which would
+        # defer expiry. Both fallbacks are per-tick, so a mixed trace
+        # flips between paths without any state migration (the spec step
+        # and the plain window share the same caches and positions).
+        if self.speculate_gamma > 0 and not any(
+                g.req.deadline is not None for g in self._groups):
+            with span("serve.decode", path="spec",
+                      k=self.speculate_gamma, request_ids=active_ids):
+                return self._spec_step()
         k = self._plan_window()
         with span("serve.decode", path="fused", k=k,
                   request_ids=active_ids):

@@ -28,6 +28,9 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
                 decode_window: int = 1,
                 kv_block_size: int = 0, kv_blocks: int = 0,
                 prefix_cache_size: int = 0,
+                speculate_gamma: int = 0,
+                draft_cfg: Optional[ExperimentConfig] = None,
+                quantize: str = "",
                 step: int = 0, vocab: str = "", allow_init: bool = False,
                 clock=time.monotonic) -> Tuple[Engine, object, int]:
     """Build an Engine from a trained experiment.
@@ -35,6 +38,14 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
     Returns ``(engine, bpe_or_None, checkpoint_step)``;
     ``checkpoint_step`` is -1 when ``allow_init`` let a missing checkpoint
     fall back to random init (smoke/bench mode — never a real deployment).
+
+    ``speculate_gamma > 0`` turns on speculative decoding. With
+    ``draft_cfg`` (a second, shrunk experiment sharing the target's vocab)
+    the draft checkpoint is restored through the same retry-wrapped path;
+    without it the engine self-drafts — exact but speedup-free, the
+    smoke/parity configuration. ``quantize="int8"`` hands the engine
+    weight-only int8 serving: the fp32 restore stays canonical and the
+    engine quantizes (and re-quantizes on every ``swap_variables``).
     """
     from ..train.run import _workdir_and_ckpt_dir
     from ..train.task import Seq2SeqTask, build_task
@@ -70,6 +81,33 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
         from ..data.bpe import Bpe
 
         bpe = Bpe.load(vocab)
+    draft_model = draft_variables = None
+    if draft_cfg is not None:
+        if speculate_gamma <= 0:
+            raise ValueError("draft_cfg given but speculate_gamma is 0")
+        draft_cfg.mesh = MeshConfig(data=-1)
+        draft_task = build_task(draft_cfg)
+        if not isinstance(draft_task, Seq2SeqTask):
+            raise ValueError(
+                f"draft model {draft_cfg.model.name!r} is not an NMT "
+                f"encoder-decoder")
+        draft_init = draft_task.init(
+            jax.random.PRNGKey(draft_cfg.train.seed))
+        _, draft_ckpt_dir = _workdir_and_ckpt_dir(draft_cfg)
+        draft_manager = CheckpointManager(
+            draft_ckpt_dir,
+            retry=retry_policy_from_config(draft_cfg.checkpoint))
+        if latest_checkpoint(draft_manager.store) is None:
+            if not allow_init:
+                raise FileNotFoundError(
+                    f"no committed draft checkpoint in {draft_ckpt_dir}")
+            draft_params = draft_init["params"]
+        else:
+            draft_restored, _ = draft_manager.restore_or_none(
+                {"params": draft_init["params"]})
+            draft_params = draft_restored["params"]
+        draft_model = draft_task.model
+        draft_variables = {"params": draft_params}
     engine = Engine(
         task.model, {"params": params}, capacity=capacity,
         max_src_len=max_src_len or cfg.data.seq_len,
@@ -80,6 +118,9 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
         decode_window=decode_window,
         kv_block_size=kv_block_size, kv_blocks=kv_blocks,
         prefix_cache_size=prefix_cache_size,
+        speculate_gamma=speculate_gamma,
+        draft_model=draft_model, draft_variables=draft_variables,
+        quantize=quantize,
         clock=clock)
     engine.metrics.ckpt_load_retries = manager.store_retries()
     return engine, bpe, int(at_step)

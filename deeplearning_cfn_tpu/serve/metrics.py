@@ -87,6 +87,12 @@ class ServeMetrics:
         self._prefix_size = 0
         self._prefix_lookups = None
         self._prefix_evictions = None
+        self._spec_gamma = 0
+        self._spec_proposed = None
+        self._spec_accepted = None
+        self._spec_emitted = None
+        self._spec_target_steps = None
+        self._spec_accept_rate = None
 
     # -- optional feature surfaces -----------------------------------------
 
@@ -110,6 +116,42 @@ class ServeMetrics:
             "serve_prefix_lookups_total", "prefix cache lookups by result")
         self._prefix_evictions = r.counter(
             "serve_prefix_evictions_total", "prefix cache LRU evictions")
+
+    def configure_speculation(self, gamma: int) -> None:
+        """Enable the speculative-decoding metric surface (serve_spec_*)."""
+        r = self.registry
+        self._spec_gamma = int(gamma)
+        self._spec_proposed = r.counter(
+            "serve_spec_proposed_total", "draft tokens proposed")
+        self._spec_accepted = r.counter(
+            "serve_spec_accepted_total", "draft tokens accepted")
+        self._spec_emitted = r.counter(
+            "serve_spec_emitted_total",
+            "tokens emitted by speculative steps (accepted + corrections)")
+        self._spec_target_steps = r.counter(
+            "serve_spec_target_row_steps_total",
+            "target verify row-steps (one per active row per spec call)")
+        self._spec_accept_rate = r.histogram(
+            "serve_spec_accept_rate",
+            "per-row accepted/proposed fraction per spec call")
+
+    def record_spec(self, proposed: int, accepted: int,
+                    target_row_steps: int, emitted: int,
+                    rates=()) -> None:
+        """One speculative device call: ``proposed``/``accepted`` draft
+        tokens summed over active rows, ``target_row_steps`` verify
+        row-steps and ``emitted`` tokens committed (the ratio is
+        tokens-per-target-step — kept separate from serve_tokens so
+        fallback fused windows don't dilute it), ``rates`` the per-row
+        acceptance fractions for the histogram."""
+        if self._spec_proposed is None:
+            return
+        self._spec_proposed.inc(proposed)
+        self._spec_accepted.inc(accepted)
+        self._spec_emitted.inc(emitted)
+        self._spec_target_steps.inc(target_row_steps)
+        for rate in rates:
+            self._spec_accept_rate.observe(float(rate))
 
     def record_prefix(self, hit: bool) -> None:
         if self._prefix_lookups is not None:
@@ -336,6 +378,37 @@ class ServeMetrics:
             return None
         return self.prefix_hits / lookups
 
+    @property
+    def spec_proposed(self) -> int:
+        if self._spec_proposed is None:
+            return 0
+        return int(self._spec_proposed.value())
+
+    @property
+    def spec_accepted(self) -> int:
+        if self._spec_accepted is None:
+            return 0
+        return int(self._spec_accepted.value())
+
+    @property
+    def spec_accept_rate(self) -> Optional[float]:
+        """Overall accepted/proposed fraction across all spec calls."""
+        proposed = self.spec_proposed
+        if proposed == 0:
+            return None
+        return self.spec_accepted / proposed
+
+    @property
+    def spec_tokens_per_target_step(self) -> Optional[float]:
+        """Tokens committed per target verify row-step; > 1.0 means
+        speculation is amortizing target forward passes."""
+        if self._spec_target_steps is None:
+            return None
+        steps = self._spec_target_steps.value()
+        if steps == 0:
+            return None
+        return self._spec_emitted.value() / steps
+
     def snapshot(self) -> Dict:
         snap = {
             "serve_submitted": self.submitted,
@@ -382,6 +455,17 @@ class ServeMetrics:
             snap["serve_prefix_evictions"] = \
                 int(self._prefix_evictions.value())
             snap["serve_prefix_hit_rate"] = self.prefix_hit_rate
+        if self._spec_gamma:
+            snap["serve_spec_gamma"] = self._spec_gamma
+            snap["serve_spec_proposed"] = self.spec_proposed
+            snap["serve_spec_accepted"] = self.spec_accepted
+            snap["serve_spec_accept_rate"] = self.spec_accept_rate
+            snap["serve_spec_accept_rate_p50"] = \
+                self._spec_accept_rate.percentile(50)
+            snap["serve_spec_accept_rate_p95"] = \
+                self._spec_accept_rate.percentile(95)
+            snap["serve_spec_tokens_per_target_step"] = \
+                self.spec_tokens_per_target_step
         return snap
 
     def emit(self, writer: MetricsWriter, **extra) -> None:

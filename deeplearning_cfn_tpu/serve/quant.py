@@ -1,0 +1,102 @@
+"""Weight-only int8 quantization for serving checkpoints.
+
+Decode is memory-bandwidth bound: every generated token re-reads the whole
+parameter set from HBM, so shrinking the weights shrinks the per-token
+byte traffic whether or not the matmuls get faster. This module implements
+the weight-only scheme the serve loader exposes as ``--quantize int8``:
+
+- every 2-D ``kernel`` (the Q/K/V/out and MLP projections) and the tied
+  token ``embedding`` table is stored as int8 codes plus a per-output-
+  channel float32 ``scale`` (symmetric absmax, the LLM.int8/AWQ weight-only
+  shape);
+- biases, LayerNorm statistics, and the learned position tables stay
+  float32 — they are a rounding error of the footprint and quantizing them
+  buys nothing;
+- dequantization happens inside the matmul (``models.transformer.
+  QuantDense`` / ``QuantEmbed``): the per-channel scale factors out of the
+  contraction, so the int8 tensor is what lives in HBM and what the matmul
+  streams.
+
+Activations are untouched — outputs drift only by weight rounding, which
+the bench bounds with an explicit logits-divergence check rather than a
+parity guarantee (int8 serving trades bit-identity for bytes; speculative
+decoding is the half of this PR that keeps exact parity).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+QUANT_DTYPES = ("int8",)
+
+# Symmetric int8 code range. +-127 (not -128) keeps the grid symmetric so
+# scale * code is an odd function of the weight — no zero-point needed.
+_QMAX = 127.0
+
+
+def _quantize_array(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Absmax-symmetric int8 codes + per-last-axis-channel f32 scales."""
+    w = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w), axis=tuple(range(w.ndim - 1)))
+    scale = np.where(amax > 0.0, amax / _QMAX, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w / scale), -_QMAX, _QMAX).astype(np.int8)
+    return q, scale
+
+
+def quantize_variables(variables: dict, dtype: str = "int8") -> dict:
+    """Quantize a checkpoint's params tree for the quantized model clone.
+
+    Walks ``variables["params"]`` and replaces every 2-D ``kernel`` /
+    ``embedding`` leaf with its int8 codes plus a sibling ``scale`` — the
+    exact param names ``QuantDense`` / ``QuantEmbed`` declare, so the
+    result applies against ``model.clone(quantized=True)`` with no
+    remapping. Everything else (biases, LayerNorms, position tables, and
+    any non-params collections) passes through untouched. Keying on the
+    presence of a 2-D ``kernel``/``embedding`` leaf — not on module names —
+    keeps the rule stable across architectures; LayerNorm's own ``scale``
+    param is safe because LayerNorm dicts carry no ``kernel``.
+    """
+    if dtype not in QUANT_DTYPES:
+        raise ValueError(
+            f"unsupported quantization dtype {dtype!r} "
+            f"(supported: {', '.join(QUANT_DTYPES)})")
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for key, val in node.items():
+            if isinstance(val, dict):
+                out[key] = walk(val)
+            elif key in ("kernel", "embedding") and \
+                    getattr(val, "ndim", 0) == 2:
+                q, scale = _quantize_array(val)
+                out[key] = q
+                out["scale"] = scale
+            else:
+                out[key] = val
+        return out
+
+    return {k: (walk(v) if k == "params" else v)
+            for k, v in variables.items()}
+
+
+def variables_bytes(variables: dict) -> int:
+    """Total parameter bytes as stored (int8 tensors count 1 byte/elem) —
+    the number the bench reports as ``weight_bytes``."""
+    import jax
+
+    return int(sum(np.asarray(leaf).nbytes
+                   for leaf in jax.tree_util.tree_leaves(variables)))
+
+
+def quantized_model(model):
+    """Clone a Flax module with ``quantized=True`` so its decode-path
+    Dense/Embed layers expect the int8 params ``quantize_variables``
+    produces. The module must expose a ``quantized`` field (the shared
+    transformer blocks do)."""
+    if not hasattr(model, "quantized"):
+        raise ValueError(
+            f"{type(model).__name__} has no 'quantized' field — int8 "
+            "serving needs the shared transformer blocks")
+    return model.clone(quantized=True)

@@ -145,6 +145,13 @@ class RequestQueue:
         # OverloadError retry-after hint. Bounded so the hint tracks
         # CURRENT load, not the whole process history.
         self._recent_waits = collections.deque(maxlen=64)
+        # Recent decode-window device latencies, reported by the engine via
+        # note_decode_window. The secondary retry-after source: before any
+        # admission wait exists, one decode window is the soonest a slot can
+        # free up — and with speculative decoding each window commits
+        # several tokens, so this tracks the post-speculation rate rather
+        # than the static floor.
+        self._recent_decode_windows = collections.deque(maxlen=64)
 
     @property
     def depth(self) -> int:
@@ -166,7 +173,12 @@ class RequestQueue:
             if len(self._pending) >= self.max_depth:
                 hint = percentile(list(self._recent_waits), 50)
                 if hint is None:
+                    hint = percentile(
+                        list(self._recent_decode_windows), 50)
+                if hint is None:
                     hint = self.retry_after_floor_s
+                elif self.retry_after_floor_s is not None:
+                    hint = max(hint, self.retry_after_floor_s)
                 raise OverloadError(
                     len(self._pending), self.max_depth, retry_after_s=hint)
             rid = request_id if request_id is not None \
@@ -213,6 +225,18 @@ class RequestQueue:
                 self._recent_waits.append(now - req.submitted_at)
                 return req
             return None
+
+    def note_decode_window(self, seconds: float) -> None:
+        """Record one decode-window device latency (engine-reported).
+
+        Feeds the overload retry-after hint when no admission waits have
+        been observed yet: a speculative window commits up to gamma+1
+        tokens per row, so its measured latency — not the static floor —
+        is the honest "one turn" estimate under speculation."""
+        if seconds < 0:
+            return
+        with self._lock:
+            self._recent_decode_windows.append(seconds)
 
     def requeue_front(self, req: Request) -> None:
         """Put back a request pop_ready returned but the engine could not

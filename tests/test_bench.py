@@ -189,6 +189,28 @@ def test_finalize_green_nulls_any_unmeasured_record(monkeypatch):
     assert "cpu_fallback_value" not in rec
 
 
+def test_finalize_green_nulls_serving_perf_fields_when_unmeasured(
+        monkeypatch):
+    """The serving-scenario perf fields (speculation/quantization) follow
+    the same null-over-zero rule on measured=false — and are left alone
+    on records that never carried them."""
+    w = _load_wrapper()
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    rec = w._finalize_green(
+        {"measured": False, "value": 99.9, "spec_gamma": 2,
+         "spec_accept_rate": 0.9, "tokens_per_target_step": 2.5,
+         "weight_bytes": 12345, "device_kind": "TPU v5e",
+         "error": "child: warmup diverged"},
+        alive=True, probe_note="probe: tpu alive")
+    for key in ("spec_gamma", "spec_accept_rate",
+                "tokens_per_target_step", "weight_bytes"):
+        assert rec[key] is None
+    rec = w._finalize_green(
+        {"measured": False, "value": 1.0, "device_kind": "TPU v5e",
+         "error": "x"}, alive=True, probe_note="probe: tpu alive")
+    assert "spec_gamma" not in rec  # key set untouched when absent
+
+
 def test_bench_child_measures_on_cpu():
     """The child process measures a tiny preset on the forced-CPU backend,
     prints the contract JSON with measured=true, and emits every stage

@@ -687,6 +687,47 @@ def test_e2e_rolling_upgrade_mid_stream_token_parity(tiny_fleet_setup):
         assert router.replica(rid).state is ReplicaState.HEALTHY
 
 
+def test_e2e_rolling_upgrade_requantizes_int8_fleet(tiny_fleet_setup):
+    """Fleet rollout against a --quantize int8 fleet: rolling_upgrade
+    hands every replica the fp32 checkpoint, and swap_variables
+    re-quantizes it inside the engine — the fleet keeps serving int8
+    (identical tokens before and after the swap, int8 params in every
+    engine)."""
+    import jax
+    import numpy as np
+
+    from deeplearning_cfn_tpu.models.transformer_nmt import (
+        transformer_nmt_tiny,
+    )
+    from deeplearning_cfn_tpu.serve.engine import Engine
+
+    s = tiny_fleet_setup
+    src_len, max_new = 8, s["max_new"]
+    model = transformer_nmt_tiny(vocab_size=96, max_len=64)
+    reps = []
+    for i in range(2):
+        eng = Engine(model, s["variables"], capacity=2,
+                     max_src_len=src_len, queue_depth=len(s["trace"]),
+                     default_max_new_tokens=max_new, decode_window=2,
+                     quantize="int8")
+        reps.append(EngineReplica(f"replica-{i}", eng))
+    router = Router(reps, policy="least_loaded")
+    rids = _route_all(router, s["trace"], max_new)
+    router.run_until_drained()
+    before = [router.result(rid)["tokens"] for rid in rids]
+    report = rolling_upgrade(router, s["variables"])  # fp32 checkpoint in
+    assert report.ok and len(report.upgraded) == 2
+    for rid in router.replica_ids():
+        eng = router.replica(rid).engine
+        assert any(np.asarray(l).dtype == np.int8
+                   for l in jax.tree_util.tree_leaves(eng.variables))
+    rids2 = _route_all(router, s["trace"], max_new)
+    router.run_until_drained()
+    after = [router.result(rid)["tokens"] for rid in rids2]
+    assert after == before  # same weights in → same int8 serving out
+    assert router.stats()["dropped_requests"] == 0
+
+
 def test_e2e_chaos_kill_mid_decode_token_parity(tiny_fleet_setup):
     """The chaos variant: runtime/faults.py kills replica-0 mid-decode;
     its in-flight requests re-run on the survivor and the fleet aggregate

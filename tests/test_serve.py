@@ -1089,3 +1089,291 @@ def test_cli_bench_serve_smoke_emits_contract_record(capsys):
     assert rec["value"] is not None and rec["value"] > 0
     assert rec["decode_window"] >= 1
     assert rec["step_latency_p50_s"] is not None
+
+
+# -- speculative decoding ---------------------------------------------------
+
+
+@pytest.mark.parametrize("paged", [False, True])
+@pytest.mark.parametrize("window", [1, 4])
+@pytest.mark.parametrize("gamma", [1, 2, 4])
+def test_speculative_greedy_parity(parity_setup, gamma, window, paged):
+    """Speculative greedy is token-identical to greedy_decode_cached for
+    every sliver sentence, across draft depths, decode-window settings,
+    and both cache layouts — speculation is a scheduling optimization,
+    never a search change. Self-draft, so acceptance is total and
+    tokens-per-target-step is the γ+1 upper bound."""
+    model, variables, srcs = parity_setup
+    direct = [_direct_decode(model, variables, s, 1) for s in srcs]
+    eng = Engine(model, variables, capacity=2, max_src_len=PARITY_SRC_LEN,
+                 default_max_new_tokens=PARITY_NEW_TOKENS,
+                 decode_window=window, speculate_gamma=gamma,
+                 kv_block_size=4 if paged else 0)
+    reqs = [eng.submit(s) for s in srcs]
+    eng.run_until_drained()
+    got = [decoding.strip_special(eng.poll(r.id).tokens) for r in reqs]
+    assert got == direct
+    assert eng.metrics.spec_accept_rate == pytest.approx(1.0)
+    tpts = eng.metrics.spec_tokens_per_target_step
+    assert tpts is not None and tpts > 1.0
+
+
+@pytest.fixture(scope="module")
+def shrunk_draft(sliver_bpe):
+    """A genuinely smaller draft sharing the target's vocab and max_len —
+    different random weights, so acceptance is partial and the reject/
+    correct path is exercised for real."""
+    draft = transformer_nmt_tiny(vocab_size=sliver_bpe.vocab_size,
+                                 hidden_size=16, num_layers=1, num_heads=2,
+                                 mlp_dim=32, max_len=32)
+    dvars = draft.init(
+        jax.random.PRNGKey(7), np.zeros((1, PARITY_SRC_LEN), np.int32),
+        np.ones((1, PARITY_SRC_LEN), np.int32),
+        np.zeros((1, PARITY_SRC_LEN), np.int32), train=False)
+    return draft, {"params": dvars["params"]}
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_speculative_distinct_draft_parity(parity_setup, shrunk_draft,
+                                           paged):
+    """With a shrunk (disagreeing) draft, acceptance is partial — and the
+    output must STILL be token-identical to plain greedy: rejected windows
+    fall back to the target's correction token, never the draft's. In
+    paged mode the block tables advance by the per-row accepted length,
+    and the pool drains leak-free."""
+    model, variables, srcs = parity_setup
+    draft, dvars = shrunk_draft
+    direct = [_direct_decode(model, variables, s, 1) for s in srcs]
+    eng = Engine(model, variables, capacity=2, max_src_len=PARITY_SRC_LEN,
+                 default_max_new_tokens=PARITY_NEW_TOKENS,
+                 speculate_gamma=3, draft_model=draft,
+                 draft_variables=dvars,
+                 kv_block_size=4 if paged else 0)
+    reqs = [eng.submit(s) for s in srcs]
+    eng.run_until_drained()
+    got = [decoding.strip_special(eng.poll(r.id).tokens) for r in reqs]
+    assert got == direct
+    rate = eng.metrics.spec_accept_rate
+    assert rate is not None and rate < 1.0  # the draft really disagrees
+    tpts = eng.metrics.spec_tokens_per_target_step
+    assert tpts is not None and tpts >= 1.0  # every verify emits >= 1
+    if paged:
+        assert eng.allocator.blocks_in_use == 0  # full release on drain
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_spec_acceptance_crosses_budget_boundary(parity_setup, paged):
+    """γ=4 against a 3-token budget: the accepted window would overrun the
+    budget, so emission must truncate token-by-token exactly like the
+    fused window body — same tokens as a plain engine at the same
+    budget."""
+    model, variables, srcs = parity_setup
+    kw = dict(capacity=2, max_src_len=PARITY_SRC_LEN,
+              default_max_new_tokens=3, kv_block_size=4 if paged else 0)
+    plain = Engine(model, variables, **kw)
+    plain_reqs = [plain.submit(s) for s in srcs]
+    plain.run_until_drained()
+    spec = Engine(model, variables, speculate_gamma=4, **kw)
+    spec_reqs = [spec.submit(s) for s in srcs]
+    spec.run_until_drained()
+    for pr, sr in zip(plain_reqs, spec_reqs):
+        assert spec.poll(sr.id).tokens == plain.poll(pr.id).tokens
+        assert len(spec.poll(sr.id).tokens) <= 3
+
+
+def test_spec_draft_eos_mid_window(sched_model):
+    """An accepted EOS mid-window ends the request right there: later
+    window positions are discarded, the row releases, and positions
+    advance only past the emitted tokens. Driven through a stubbed device
+    fn so the EOS lands deterministically."""
+    eng = _mk_engine(sched_model, speculate_gamma=4, queue_depth=4)
+    req = eng.submit(_src(3), max_new_tokens=8)
+    cap, g = eng.capacity, eng.speculate_gamma
+
+    def fake(*args):
+        cache, dcache = args[2], args[3]
+        props = np.full((cap, g), 7, np.int32)
+        tgt = np.full((cap, g + 1), 7, np.int32)
+        props[:, 1] = decoding.EOS_ID
+        tgt[:, 1] = decoding.EOS_ID
+        return props, tgt, cache, dcache
+
+    eng._spec_fn_cached = fake
+    eng.step()
+    assert eng.poll(req.id).tokens == [7, decoding.EOS_ID]
+    assert eng.poll(req.id).state is RequestState.DONE
+    assert eng.active_rows == 0
+    assert int(eng._pos[0]) == 0  # row released and reset
+    assert eng.metrics.spec_tokens_per_target_step == pytest.approx(2.0)
+
+
+def test_spec_gamma_zero_degenerates_to_plain_window(sched_model):
+    """speculate_gamma=0 is exactly the pre-speculation engine: no draft
+    state, no spec jit, no serve_spec_ metric keys, same tokens."""
+    eng = _mk_engine(sched_model, speculate_gamma=0, decode_window=4)
+    assert eng.draft_model is None and eng.draft_variables is None
+    r = eng.submit(_src(5), max_new_tokens=6)
+    eng.run_until_drained()
+    assert eng._spec_fn_cached is None
+    assert not any(k.startswith("serve_spec_")
+                   for k in eng.metrics.snapshot())
+    ref = _mk_engine(sched_model, decode_window=4)
+    r2 = ref.submit(_src(5), max_new_tokens=6)
+    ref.run_until_drained()
+    assert eng.poll(r.id).tokens == ref.poll(r2.id).tokens
+
+
+def test_spec_falls_back_for_deadlines_and_beams(parity_setup):
+    """A pending deadline (or a beam group) must drop the tick to the
+    non-speculative path — expiry lands within one plain step — and the
+    trace stays parity-exact across the path flips."""
+    model, variables, srcs = parity_setup
+    eng = Engine(model, variables, capacity=3, max_src_len=PARITY_SRC_LEN,
+                 default_max_new_tokens=PARITY_NEW_TOKENS,
+                 speculate_gamma=2)
+    reqs = []
+    for i, s in enumerate(srcs):
+        kw = {"deadline_s": 60.0} if i % 2 else {}
+        kw["beam_size"] = 2 if i == 3 else 1
+        reqs.append(eng.submit(s, **kw))
+    eng.run_until_drained()
+    for i, (r, s) in enumerate(zip(reqs, srcs)):
+        want = _direct_decode(model, variables, s, 2 if i == 3 else 1)
+        assert decoding.strip_special(eng.poll(r.id).tokens) == want
+
+
+def test_spec_engine_validates_draft(sched_model):
+    model, variables = sched_model
+    with pytest.raises(ValueError):
+        Engine(model, variables, speculate_gamma=-1)
+    with pytest.raises(ValueError):  # draft model without variables
+        Engine(model, variables, speculate_gamma=2, draft_model=model)
+    short = transformer_nmt_tiny(vocab_size=SCHED_VOCAB, hidden_size=16,
+                                 num_layers=1, num_heads=2, mlp_dim=32,
+                                 max_len=16)
+    svars = short.init(
+        jax.random.PRNGKey(2), np.zeros((1, SCHED_SRC_LEN), np.int32),
+        np.ones((1, SCHED_SRC_LEN), np.int32),
+        np.zeros((1, SCHED_SRC_LEN), np.int32), train=False)
+    with pytest.raises(ValueError):  # draft max_len < target max_len
+        Engine(model, variables, speculate_gamma=2, draft_model=short,
+               draft_variables={"params": svars["params"]})
+
+
+def test_serve_metrics_spec_keys_are_conditional():
+    """serve_spec_* keys exist only once speculation is configured — the
+    same conditional-surface contract as the paged/prefix keys."""
+    base = ServeMetrics(capacity=2, clock=FakeClock())
+    assert not any(k.startswith("serve_spec_") for k in base.snapshot())
+    m = ServeMetrics(capacity=2, clock=FakeClock())
+    m.configure_speculation(2)
+    m.record_spec(proposed=4, accepted=3, target_row_steps=2, emitted=5,
+                  rates=[1.0, 0.5])
+    snap = m.snapshot()
+    assert snap["serve_spec_gamma"] == 2
+    assert snap["serve_spec_proposed"] == 4
+    assert snap["serve_spec_accepted"] == 3
+    assert snap["serve_spec_accept_rate"] == pytest.approx(0.75)
+    assert 0.5 <= snap["serve_spec_accept_rate_p50"] <= 1.0
+    assert 0.5 <= snap["serve_spec_accept_rate_p95"] <= 1.0
+    assert snap["serve_spec_tokens_per_target_step"] == pytest.approx(2.5)
+
+
+def test_overload_hint_falls_back_to_decode_window():
+    """With no admission waits observed yet, the retry-after hint comes
+    from the measured decode-window latency (the post-speculation rate),
+    not the static floor."""
+    q = RequestQueue(max_depth=1, clock=FakeClock())
+    q.note_decode_window(0.2)
+    q.note_decode_window(0.2)
+    q.submit([5], max_new_tokens=2)
+    with pytest.raises(OverloadError) as ei:
+        q.submit([6], max_new_tokens=2)
+    assert ei.value.retry_after_s == pytest.approx(0.2)
+
+
+# -- int8 weight-only quantization ------------------------------------------
+
+
+def test_quantize_variables_int8_ratio_and_structure(sched_model):
+    from deeplearning_cfn_tpu.serve import quantize_variables, \
+        variables_bytes
+
+    model, variables = sched_model
+    q = quantize_variables(variables)
+    ratio = variables_bytes(q) / variables_bytes(variables)
+    # This 32-hidden scheduler model keeps a larger share of its bytes in
+    # the unquantized position tables / LayerNorms than the bench model
+    # does, so the bound here is looser than the 0.35 serving contract
+    # (asserted on the bench model in the record-fields test below).
+    assert ratio <= 0.40
+    leaves = jax.tree_util.tree_leaves(q)
+    assert any(np.asarray(l).dtype == np.int8 for l in leaves)
+    # The fp32 source tree is untouched (quantization is a pure function).
+    assert all(np.asarray(l).dtype != np.int8
+               for l in jax.tree_util.tree_leaves(variables))
+    with pytest.raises(ValueError):
+        quantize_variables(variables, dtype="int4")
+
+
+def test_quantized_serving_divergence_bounded(sched_model):
+    """One fp32-vs-int8 forward pass stays inside the relative logits
+    bound the bench gates on."""
+    from deeplearning_cfn_tpu.serve.bench import _quant_divergence
+
+    model, variables = sched_model
+    diff, bound, ok = _quant_divergence(model, variables, SCHED_SRC_LEN,
+                                        SCHED_VOCAB, seed=0)
+    assert ok is True and diff <= bound
+
+
+def test_quantized_engine_serves_and_spec_parity(sched_model):
+    """An int8 engine serves end-to-end, and speculation on top of it is
+    token-identical to the plain int8 engine (parity is within the
+    quantized model, not across precisions)."""
+    plain = _mk_engine(sched_model, quantize="int8")
+    spec = _mk_engine(sched_model, quantize="int8", speculate_gamma=2)
+    srcs = [_src(i) for i in range(4)]
+    p_reqs = [plain.submit(s, max_new_tokens=8) for s in srcs]
+    plain.run_until_drained()
+    s_reqs = [spec.submit(s, max_new_tokens=8) for s in srcs]
+    spec.run_until_drained()
+    for pr, sr in zip(p_reqs, s_reqs):
+        assert plain.poll(pr.id).state is RequestState.DONE
+        assert spec.poll(sr.id).tokens == plain.poll(pr.id).tokens
+
+
+def test_swap_variables_requantizes_for_quantized_engine(sched_model):
+    """Fleet rollout against a --quantize int8 fleet: swap receives the
+    fp32 checkpoint, the engine re-quantizes it (and re-points the
+    self-draft alias), and serving continues with identical output."""
+    model, variables = sched_model
+    eng = _mk_engine(sched_model, quantize="int8", speculate_gamma=2)
+    r1 = eng.submit(_src(4), max_new_tokens=6)
+    eng.run_until_drained()
+    before = eng.poll(r1.id).tokens
+    eng.swap_variables(variables)  # fp32 in → int8 inside
+    assert any(np.asarray(l).dtype == np.int8
+               for l in jax.tree_util.tree_leaves(eng.variables))
+    assert eng.draft_variables is eng.variables  # self-draft re-aliased
+    r2 = eng.submit(_src(4), max_new_tokens=6)
+    eng.run_until_drained()
+    assert eng.poll(r2.id).tokens == before
+
+
+def test_serve_bench_speculate_and_quantize_record_fields():
+    """The bench record carries the speculation/quantization perf fields
+    (and their contracts) the t1 gates assert on."""
+    from deeplearning_cfn_tpu.serve.bench import run_serve_bench
+
+    rec = run_serve_bench(num_requests=4, slots=2, max_new_tokens=4,
+                          src_len=8, speculate=2, quantize="int8",
+                          smoke=True)
+    assert rec["spec_gamma"] == 2
+    assert rec["token_identical"] is True
+    assert rec["spec_accept_rate"] == pytest.approx(1.0)
+    assert rec["tokens_per_target_step"] > 1.0
+    assert rec["weight_bytes"] <= 0.35 * rec["weight_bytes_fp32"]
+    assert rec["kv_bytes"] > 0
+    assert rec["divergence_ok"] is True
+    assert rec["logits_divergence"] <= rec["divergence_bound"]
