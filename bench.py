@@ -206,7 +206,9 @@ def _finalize_green(record: dict, alive: bool, probe_note: str,
         # numbers either. Only nulled when present so non-serving records
         # keep their exact key set.
         for key in ("spec_gamma", "spec_accept_rate",
-                    "tokens_per_target_step", "weight_bytes"):
+                    "tokens_per_target_step", "weight_bytes",
+                    "e2e_latency_p50_s", "e2e_latency_p95_s",
+                    "goodput_tokens_per_sec", "wasted_tokens"):
             if key in record:
                 record[key] = None
     return record

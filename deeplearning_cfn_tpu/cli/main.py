@@ -356,7 +356,8 @@ def _cmd_bench(args) -> int:
                                decode_window=args.decode_window,
                                policy=args.fleet_policy,
                                chaos_kill_step=args.fleet_chaos_step,
-                               smoke=args.smoke)
+                               smoke=args.smoke,
+                               trace_dir=args.fleet_trace_dir)
         print(json.dumps(line))
         return 0
     if getattr(args, "obs_smoke", False):
@@ -1043,27 +1044,36 @@ def _cmd_obs_summarize(args) -> int:
 def _cmd_obs_export(args) -> int:
     """JSONL streams → Chrome/Perfetto trace.json (load in
     ui.perfetto.dev or chrome://tracing)."""
-    from ..obs.export import export_trace
+    from ..obs.export import export_fleet_trace, export_trace
 
     path = args.path
     if not os.path.exists(path):
         print(f"[dlcfn-tpu] ERROR: no metrics file or directory at {path}",
               file=sys.stderr)
         return 1
+    fleet = getattr(args, "fleet", False)
+    if fleet and not os.path.isdir(path):
+        print(f"[dlcfn-tpu] ERROR: --fleet needs a fleet trace "
+              f"directory, got a file: {path}", file=sys.stderr)
+        return 1
     out = args.out
     if not out:
         d = path if os.path.isdir(path) else os.path.dirname(path) or "."
         out = os.path.join(d, "trace.json")
     try:
-        summary = export_trace(path, out)
+        summary = export_fleet_trace(path, out) if fleet \
+            else export_trace(path, out)
     except OSError as e:
         print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
         return 1
     for p in summary["problems"]:
         print(f"[dlcfn-tpu] WARNING: trace problem: {p}", file=sys.stderr)
+    extra = (f", {summary['flow_events']} flow link(s) across "
+             f"{len(summary['shards'])} shard(s)") if fleet else ""
     print(f"[dlcfn-tpu] wrote {summary['out']}: {summary['events']} "
-          f"events ({summary['spans']} spans) from {summary['records']} "
-          f"records — open in https://ui.perfetto.dev")
+          f"events ({summary['spans']} spans{extra}) from "
+          f"{summary['records']} records — open in "
+          f"https://ui.perfetto.dev")
     if summary["records"] == 0:
         print(f"[dlcfn-tpu] no JSONL records found under {path}",
               file=sys.stderr)
@@ -1667,6 +1677,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fleet scenario: crash-inject replica-0 on its "
                          "Nth decode step (0 = off) — the chaos variant "
                          "of the zero-drop contract")
+    be.add_argument("--fleet-trace-dir", default=None,
+                    help="fleet scenario: write per-replica span shards, "
+                         "router fleet.request spans and the signal "
+                         "snapshot under DIR (merge with "
+                         "'obs export --fleet DIR')")
     be.add_argument("--obs-smoke", action="store_true",
                     help="obs overhead smoke: step time instrumented vs "
                          "spans disabled (the <=5%% gate; use "
@@ -1712,6 +1727,11 @@ def build_parser() -> argparse.ArgumentParser:
     obexp.add_argument("-o", "--out", default="",
                        help="output path (default: trace.json next to "
                             "the input)")
+    obexp.add_argument("--fleet", action="store_true",
+                       help="treat PATH as a fleet trace root (router "
+                            "*.jsonl at the top, one shard dir per "
+                            "replica) and merge every shard into ONE "
+                            "timeline with cross-process flow arrows")
     obexp.set_defaults(fn=_cmd_obs_export)
 
     obchk = obsub.add_parser(

@@ -21,8 +21,9 @@ same contract (``dropped_requests`` still 0, tokens still identical).
 
 from __future__ import annotations
 
+import os
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -66,12 +67,19 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
                     decode_window: int = 4,
                     policy: str = "least_loaded",
                     chaos_kill_step: int = 0,
-                    smoke: bool = False) -> Dict:
+                    smoke: bool = False,
+                    trace_dir: Optional[str] = None) -> Dict:
     """Route the fixed trace across ``replicas`` engines to drain;
     return the BENCH-contract record with the fleet fields. ``smoke``
     shrinks the scenario AND runs the single-engine parity baseline
     (the t1.sh gate asserts ``token_identical`` and
-    ``dropped_requests == 0``)."""
+    ``dropped_requests == 0``).
+
+    ``trace_dir`` arms fleet tracing: each replica writes its span shard
+    to ``<dir>/<replica>/metrics.jsonl``, the router writes its
+    ``fleet.request`` spans to ``<dir>/router.jsonl`` and the end-of-run
+    signal snapshot to ``<dir>/signals.jsonl`` — the layout
+    ``obs export --fleet <dir>`` merges into one Perfetto timeline."""
     import jax
 
     from ..models.transformer_nmt import transformer_nmt_tiny
@@ -116,6 +124,27 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         members.append(rep)
     router = Router(members, policy=policy)
 
+    writers = []
+    if trace_dir is not None:
+        from ..metrics.jsonl import MetricsWriter
+        from ..obs.sinks import JsonlSink
+
+        # One shard per process-equivalent: warmup ran before the sinks
+        # attach, so the shards hold only routed traffic.
+        router_writer = MetricsWriter(
+            os.path.join(trace_dir, "router.jsonl"),
+            also_stdout=False, all_processes=True)
+        writers.append(router_writer)
+        router.trace_sink = JsonlSink(router_writer)
+        rep_writers: Dict[str, MetricsWriter] = {}
+        for rep in members:
+            w = MetricsWriter(
+                os.path.join(trace_dir, rep.id, "metrics.jsonl"),
+                also_stdout=False, all_processes=True)
+            writers.append(w)
+            rep_writers[rep.id] = w
+            rep.trace_sink = JsonlSink(w)
+
     t0 = time.monotonic()
     rids = []
     for src in trace:
@@ -150,6 +179,36 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
             "mean_slot_occupancy": round(m.mean_slot_occupancy or 0.0, 4),
         })
 
+    # Per-request ledger aggregates (router._finalize ran for every
+    # finished rid via result() above). The goodput contract: every
+    # decoded token is either goodput (in a DONE result) or waste
+    # (decoded on an attempt the router abandoned) — the two sum to the
+    # fleet's total decoded tokens, exactly.
+    e2e = [router.ledger[rid]["e2e_s"] for rid in rids
+           if rid in router.ledger
+           and router.ledger[rid]["e2e_s"] is not None]
+    goodput = router.goodput_tokens
+    wasted = router.wasted_tokens
+    goodput_sum_ok = (goodput + wasted) == total_tokens
+
+    if trace_dir is not None:
+        from ..obs.signals import SignalBus
+
+        bus = SignalBus(names=[rep.id for rep in members])
+        for rep in members:
+            rep.engine.metrics.emit(rep_writers[rep.id], replica=rep.id)
+            bus.observe(rep.id, rep.engine.metrics.snapshot())
+        signals_writer = MetricsWriter(
+            os.path.join(trace_dir, "signals.jsonl"),
+            also_stdout=False, all_processes=True)
+        writers.append(signals_writer)
+        signals_writer.write(bus.snapshot())
+        router.trace_sink = None
+        for rep in members:
+            rep.trace_sink = None
+        for w in writers:
+            w.close()
+
     token_identical = None
     if smoke:
         baseline = _single_engine_tokens(
@@ -173,6 +232,14 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         "token_identical": token_identical,
         "p50_latency_s": percentile(lat, 50),
         "p95_latency_s": percentile(lat, 95),
+        "e2e_latency_p50_s": percentile(e2e, 50),
+        "e2e_latency_p95_s": percentile(e2e, 95),
+        "goodput_tokens": goodput,
+        "wasted_tokens": wasted,
+        "goodput_tokens_per_sec":
+            round(goodput / elapsed, 2) if elapsed > 0 else None,
+        "goodput_sum_ok": goodput_sum_ok,
+        "trace_dir": trace_dir,
         "requests": num_requests,
         "slots": slots,
         "max_new_tokens": max_new_tokens,

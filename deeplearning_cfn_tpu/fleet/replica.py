@@ -28,6 +28,7 @@ the router (control plane) never touches a process; the supervisor
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import enum
 import time
@@ -36,6 +37,8 @@ from typing import Dict, List, Optional
 from ..launch.launcher import JobHandle, JobLauncher, Transport, \
     classify_attempt
 from ..metrics.jsonl import MetricsWriter
+from ..obs.sinks import JsonlSink
+from ..obs.trace import get_tracer, obs_enabled
 from ..runtime.cluster import ClusterSpec
 from ..serve.metrics import percentile
 
@@ -72,6 +75,24 @@ class EngineReplica:
         self.fault_plan = fault_plan
         self.crashed = False
         self.steps = 0
+        # Per-replica trace shard. The engine emits spans through the
+        # process-global tracer; attaching this sink only for the
+        # duration of THIS replica's step keeps its spans out of the
+        # other replicas' shards even though all engines share one
+        # tracer in-process.
+        self.trace_sink = None
+
+    @contextlib.contextmanager
+    def _traced(self):
+        if self.trace_sink is None:
+            yield
+            return
+        tracer = get_tracer()
+        tracer.add_sink(self.trace_sink)
+        try:
+            yield
+        finally:
+            tracer.remove_sink(self.trace_sink)
 
     # -- routing surface ----------------------------------------------------
 
@@ -125,9 +146,33 @@ class EngineReplica:
                         spec.message or f"injected fatal on {self.id}")
                 if spec.kind == "latency":
                     time.sleep(spec.latency_s)
-        n = self.engine.step()
+        with self._traced():
+            n = self.engine.step()
         self.steps += 1
         return n
+
+    def record_evacuation(self, req, now: float) -> None:
+        """Write the abandoned attempt into THIS replica's trace shard.
+
+        A replica that crashed or tripped its breaker is never stepped
+        again, so the engine's own release path (which emits the
+        ``serve.request`` span) cannot run for its in-flight copies. The
+        router calls this at evacuation so the merged fleet timeline
+        still shows the attempt — state ``evacuated``, with the tokens
+        the fleet is about to re-decode elsewhere."""
+        if not obs_enabled():
+            return
+        t0 = getattr(req, "submitted_at", None)
+        if not isinstance(t0, (int, float)):
+            return
+        with self._traced():
+            get_tracer().record_span(
+                "serve.request", t0, max(now - t0, 0.0), ok=False,
+                request_id=getattr(req, "id", None),
+                trace_id=getattr(req, "trace_id", None)
+                or getattr(req, "id", None),
+                state="evacuated", replica=self.id,
+                tokens=len(getattr(req, "tokens", ()) or ()))
 
     # -- health / rollout ---------------------------------------------------
 
@@ -189,6 +234,7 @@ class _SupervisedReplica:
         self.events = events
         self.handle: Optional[JobHandle] = None
         self.attempt = 0
+        self.started_ts: Optional[float] = None
         self.outcomes: List[str] = []
         self.state = "pending"  # pending | running | ok | failed
 
@@ -235,6 +281,7 @@ class ReplicaSupervisor:
         sup.handle = sup.launcher.start(
             cluster, spec.argv, os.path.join(spec.run_dir, "logs"),
             attempt=sup.attempt, extra_env=spec.env, cwd=spec.cwd)
+        sup.started_ts = time.monotonic()
         sup.state = "running"
 
     def start(self) -> None:
@@ -257,6 +304,7 @@ class ReplicaSupervisor:
                 "event": "launch_attempt", "attempt": sup.attempt,
                 "replica": sup.spec.replica_id, "outcome": outcome,
                 "exit_codes": codes, "success": outcome == "ok"})
+            self._record_attempt_span(sup, outcome)
             if outcome == "ok":
                 sup.state = "ok"
             elif sup.attempt < self.max_restarts:
@@ -265,6 +313,26 @@ class ReplicaSupervisor:
             else:
                 sup.state = "failed"
         return self.status_states()
+
+    def _record_attempt_span(self, sup: _SupervisedReplica,
+                             outcome: str) -> None:
+        """Retroactive ``launch.attempt`` span into the replica's own
+        launch.jsonl, carrying the hang-vs-crash classification as a
+        span attribute — `obs export` renders the attempt bar with the
+        outcome attached, same shape as the single-job launcher's."""
+        if not obs_enabled() or sup.started_ts is None:
+            return
+        tracer = get_tracer()
+        sink = JsonlSink(sup.events)
+        tracer.add_sink(sink)
+        try:
+            tracer.record_span(
+                "launch.attempt", sup.started_ts,
+                max(time.monotonic() - sup.started_ts, 0.0),
+                ok=outcome == "ok", outcome=outcome,
+                replica=sup.spec.replica_id, attempt=sup.attempt)
+        finally:
+            tracer.remove_sink(sink)
 
     def status_states(self) -> Dict[str, str]:
         return {sup.spec.replica_id: sup.state for sup in self._replicas}

@@ -32,8 +32,10 @@ suite runs them over fake replicas with scripted loads).
 from __future__ import annotations
 
 import itertools
+import time
 from typing import Dict, List, Optional, Tuple
 
+from ..obs.trace import get_tracer, obs_enabled
 from ..serve.queue import OverloadError
 from .replica import EngineReplica, ReplicaCrashed, ReplicaState
 
@@ -146,6 +148,13 @@ class _LogicalRequest:
         self.replica_id: Optional[str] = None
         self.replica_rid: Optional[str] = None
         self.attempts = 0
+        # -- latency ledger / trace context ---------------------------
+        self.submitted_ts: Optional[float] = None   # router clock
+        self.lost_at: Optional[float] = None        # evacuated, unplaced
+        self.stall_s = 0.0          # time spent with no replica copy
+        self.wasted_tokens = 0      # decoded on attempts we abandoned
+        self.hops: List[str] = []   # every replica that held a copy
+        self.finalized = False
 
 
 class Router:
@@ -159,7 +168,8 @@ class Router:
     """
 
     def __init__(self, replicas: List[EngineReplica],
-                 policy="least_loaded", breaker_threshold: int = 3):
+                 policy="least_loaded", breaker_threshold: int = 3,
+                 clock=time.monotonic):
         if breaker_threshold < 1:
             raise ValueError(
                 f"breaker_threshold must be >= 1, got {breaker_threshold}")
@@ -181,6 +191,21 @@ class Router:
         # terminal state and no path to one. Stays 0 — the bench record
         # and the chaos tests assert it.
         self.dropped_requests = 0
+        self._clock = clock
+        # Fleet-level trace shard: when set (a sink from obs/sinks.py),
+        # the router writes one retroactive ``fleet.request`` span per
+        # finished logical request into it. All in-process engines share
+        # ``time.monotonic``, so router spans and replica spans land on
+        # one comparable timeline.
+        self.trace_sink = None
+        # Goodput accounting. goodput = tokens in DONE logical results;
+        # wasted = tokens decoded on attempts the router abandoned
+        # (evacuation re-decode). Per-request phase breakdowns live in
+        # ``ledger`` (rid → dict), written when a request is first
+        # OBSERVED finished.
+        self.goodput_tokens = 0
+        self.wasted_tokens = 0
+        self.ledger: Dict[str, Dict] = {}
 
     # -- membership ---------------------------------------------------------
 
@@ -224,6 +249,7 @@ class Router:
         lr = _LogicalRequest(rid, dict(
             src_ids=list(src_ids), max_new_tokens=max_new_tokens,
             beam_size=beam_size, deadline_s=deadline_s))
+        lr.submitted_ts = self._clock()
         self._requests[rid] = lr
         try:
             self._place(lr)
@@ -251,7 +277,8 @@ class Router:
                          max_new_tokens=lr.spec["max_new_tokens"],
                          beam_size=lr.spec["beam_size"],
                          deadline_s=lr.spec["deadline_s"],
-                         request_id=replica_rid)
+                         request_id=replica_rid,
+                         trace_id=lr.rid)
             except OverloadError as e:
                 hints[rep_id] = e.retry_after_s
                 continue
@@ -262,6 +289,12 @@ class Router:
                 continue
             lr.replica_id = rep_id
             lr.replica_rid = replica_rid
+            lr.hops.append(rep_id)
+            if lr.lost_at is not None:
+                # Re-placed after an evacuation: the gap with no live
+                # copy is stall time in the request's phase ledger.
+                lr.stall_s += max(self._clock() - lr.lost_at, 0.0)
+                lr.lost_at = None
             self.policy.note_routed(rep_id)
             self.routed[rep_id] = self.routed.get(rep_id, 0) + 1
             return
@@ -324,19 +357,32 @@ class Router:
         for lr in list(self._requests.values()):
             if lr.replica_id != rep_id:
                 continue
-            try:
-                if lr.replica_rid is not None \
-                        and r.poll(lr.replica_rid).finished:
-                    continue   # completed before the failure — keep it
-            except (KeyError, ReplicaCrashed):
-                pass
+            req = None
+            if lr.replica_rid is not None:
+                try:
+                    req = r.poll(lr.replica_rid)
+                except (KeyError, ReplicaCrashed):
+                    req = None
+            if req is not None and req.finished:
+                continue   # completed before the failure — keep it
             if cancel_on_replica and lr.replica_rid is not None:
                 try:
                     r.cancel(lr.replica_rid)
                 except (KeyError, ReplicaCrashed):
                     pass
+            now = self._clock()
+            if req is not None:
+                # Tokens the abandoned attempt already decoded are waste:
+                # the re-placed copy decodes them again elsewhere.
+                n = len(getattr(req, "tokens", ()) or ())
+                lr.wasted_tokens += n
+                self.wasted_tokens += n
+                recorder = getattr(r, "record_evacuation", None)
+                if recorder is not None:
+                    recorder(req, now)
             lr.replica_id = None
             lr.replica_rid = None
+            lr.lost_at = now
             self.evacuations += 1
             try:
                 self._place(lr)
@@ -381,7 +427,10 @@ class Router:
 
     def finished(self, rid: str) -> bool:
         req = self.poll(rid)
-        return req is not None and req.finished
+        done = req is not None and req.finished
+        if done:
+            self._finalize(self._requests[rid], req)
+        return done
 
     def pending(self) -> List[str]:
         return [rid for rid in self._requests if not self.finished(rid)]
@@ -390,10 +439,76 @@ class Router:
         req = self.poll(rid)
         if req is None:
             return {"id": rid, "state": "backlogged", "tokens": []}
+        if req.finished:
+            self._finalize(self._requests[rid], req)
         out = req.to_dict()
         out["id"] = rid   # logical id, not the per-attempt replica id
         out["replica"] = self._requests[rid].replica_id
         return out
+
+    def _finalize(self, lr: _LogicalRequest, req) -> None:
+        """First observation of a terminal state: write the request's
+        phase ledger entry, account goodput, emit the fleet.request
+        span. Idempotent — every later poll is a no-op."""
+        if lr.finalized:
+            return
+        lr.finalized = True
+        now = self._clock()
+        state = getattr(getattr(req, "state", None), "value",
+                        getattr(req, "state", None))
+        tokens = len(getattr(req, "tokens", ()) or ())
+        goodput = tokens if state == "done" else 0
+        self.goodput_tokens += goodput
+
+        def _ts(name):
+            v = getattr(req, name, None)
+            return v if isinstance(v, (int, float)) else None
+
+        t_sub, t_adm, t_fin = (_ts("submitted_at"), _ts("admitted_at"),
+                               _ts("finished_at"))
+        prefill = _ts("prefill_s")
+        queue_wait = max(t_adm - t_sub, 0.0) \
+            if t_sub is not None and t_adm is not None else None
+        decode = max(t_fin - t_adm - (prefill or 0.0), 0.0) \
+            if t_adm is not None and t_fin is not None else None
+        emit = max(now - t_fin, 0.0) if t_fin is not None else None
+        e2e = max(now - lr.submitted_ts, 0.0) \
+            if lr.submitted_ts is not None else None
+        self.ledger[lr.rid] = {
+            "request_id": lr.rid, "state": state,
+            "attempts": lr.attempts, "replicas": list(lr.hops),
+            "goodput_tokens": goodput, "wasted_tokens": lr.wasted_tokens,
+            "e2e_s": e2e,
+            "phases": {"queue_wait_s": queue_wait, "prefill_s": prefill,
+                       "decode_s": decode, "stall_s": lr.stall_s,
+                       "emit_s": emit},
+        }
+        self._emit_request_span(lr, self.ledger[lr.rid])
+
+    def _emit_request_span(self, lr: _LogicalRequest, entry: Dict) -> None:
+        """Retroactive ``fleet.request`` span covering submit → observed
+        finish, written into the router's own trace shard. Carries the
+        trace context plus the phase ledger as attributes, so the merged
+        Perfetto timeline shows the logical request above its
+        per-replica attempts."""
+        if not obs_enabled() or lr.submitted_ts is None:
+            return
+        tracer = get_tracer()
+        if self.trace_sink is not None:
+            tracer.add_sink(self.trace_sink)
+        try:
+            tracer.record_span(
+                "fleet.request", lr.submitted_ts, entry["e2e_s"] or 0.0,
+                ok=entry["state"] == "done",
+                request_id=lr.rid, trace_id=lr.rid,
+                state=entry["state"], attempts=lr.attempts,
+                replicas=",".join(lr.hops),
+                goodput_tokens=entry["goodput_tokens"],
+                wasted_tokens=entry["wasted_tokens"],
+                stall_s=entry["phases"]["stall_s"])
+        finally:
+            if self.trace_sink is not None:
+                tracer.remove_sink(self.trace_sink)
 
     def run_until_drained(self, max_steps: int = 1_000_000) -> int:
         """Step until every logical request reaches a terminal state (or
@@ -432,4 +547,6 @@ class Router:
             "backlog": len(self._backlog),
             "evacuations": self.evacuations,
             "dropped_requests": self.dropped_requests,
+            "goodput_tokens": self.goodput_tokens,
+            "wasted_tokens": self.wasted_tokens,
         }

@@ -29,12 +29,25 @@ is the one telemetry layer under all of them:
   series, report p50/p95 deltas, flag direction-aware regressions.
 - :mod:`obs.tail` — ``dlcfn-tpu obs tail``: truncation-tolerant live
   follower rendering a one-line train/serve status as the JSONL grows.
+- :mod:`obs.signals` — the fleet signal bus: per-replica rolling-window
+  aggregates (windowed p50/p95 latency, queue depth, tokens/sec, spec
+  accept rate, retry-after pressure) folded from the same JSONL streams,
+  serialized as ``signal_snapshot`` records — the one fold
+  ``obs tail --fleet``, ``obs summarize --fleet`` and an autoscale
+  controller all consume.
 
 See docs/OBSERVABILITY.md for instrument/span naming conventions.
 """
 
 from .diff import diff_runs, render_diff  # noqa: F401
-from .export import build_trace, export_trace, validate_trace  # noqa: F401
+from .export import (  # noqa: F401
+    build_fleet_trace,
+    build_trace,
+    export_fleet_trace,
+    export_trace,
+    validate_trace,
+)
+from .signals import RollingWindow, SignalBus  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter,
     Gauge,
@@ -64,11 +77,15 @@ from .trace import (  # noqa: F401
 __all__ = [
     "AlertingWriter",
     "JsonlFollower",
+    "RollingWindow",
+    "SignalBus",
     "SloEngine",
     "TailState",
+    "build_fleet_trace",
     "build_trace",
     "check_run",
     "diff_runs",
+    "export_fleet_trace",
     "export_trace",
     "load_rules",
     "render_diff",

@@ -36,6 +36,7 @@ event timestamps are microseconds relative to the earliest event.
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Dict, List, Optional, Tuple
 
 from .report import collect
@@ -87,10 +88,15 @@ def _wall_epoch(spans: List[Dict[str, Any]],
     return min(ts) if ts else 0.0
 
 
-def build_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
-    """Records (any mix of spans / train / serve / launch / alert lines)
-    → a Trace Event Format object. Pure function of its input: no clock
-    reads, so identical records yield an identical trace."""
+def _shard_events(records: List[Dict[str, Any]], pid_spans: int,
+                  pid_requests: int, pid_counters: int
+                  ) -> Tuple[List[Dict[str, Any]], Dict[int, List[float]],
+                             List[float]]:
+    """Layout core shared by the single-run and fleet builders: records
+    → (events, pools, t_base candidates), with ``ts``/``dur`` in
+    ABSOLUTE wall-clock seconds (the caller rebases to relative µs —
+    the fleet builder needs one GLOBAL base across shards, so rebasing
+    cannot happen per shard)."""
     spans = [r for r in records if "span" in r
              and isinstance(r.get("t0_s"), (int, float))
              and isinstance(r.get("dur_s"), (int, float))]
@@ -121,7 +127,7 @@ def build_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
 
     # Track (tid) assignment: one tid per lineage; non-overlapping root
     # lineages reuse tracks greedily so the view stays compact.
-    pools: Dict[int, List[float]] = {_PID_SPANS: [], _PID_REQUESTS: []}
+    pools: Dict[int, List[float]] = {pid_spans: [], pid_requests: []}
 
     def _lineage_end(n: _SpanNode) -> float:
         return max([n.end] + [_lineage_end(c) for c in n.children])
@@ -130,9 +136,10 @@ def build_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     placed: List[Tuple[int, _SpanNode]] = []   # (pid, node)
 
     for root in sorted(roots, key=lambda n: (n.start, -n.end)):
-        pid = (_PID_REQUESTS
+        pid = (pid_requests
                if str(root.rec.get("span", "")).startswith(_REQUEST_PREFIX)
-               else _PID_SPANS)
+               or root.rec.get("span") == "fleet.request"
+               else pid_spans)
         pool = pools[pid]
         end = _lineage_end(root)
         for tid, last_end in enumerate(pool):
@@ -158,10 +165,6 @@ def build_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     times = [n.start for _, n in placed]
     times += [r["ts"] for r in others
               if isinstance(r.get("ts"), (int, float))]
-    t_base = min(times) if times else 0.0
-
-    def _us(t: float) -> float:
-        return round((t - t_base) * 1e6, 3)
 
     for pid, n in placed:
         r = n.rec
@@ -169,7 +172,7 @@ def build_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                 if k not in ("span", "t0_s", "dur_s", "ts")}
         events.append({
             "name": r["span"], "ph": "X", "pid": pid, "tid": n.tid,
-            "ts": _us(n.start), "dur": round((n.end - n.start) * 1e6, 3),
+            "ts": n.start, "dur": n.end - n.start,
             "cat": str(r["span"]).split(".")[0],
             "args": args,
         })
@@ -185,7 +188,7 @@ def build_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
                     else f"alert:{r.get('rule', '?')}")
             events.append({
                 "name": name, "ph": "i", "s": "g",
-                "pid": _PID_SPANS, "tid": 0, "ts": _us(ts),
+                "pid": pid_spans, "tid": 0, "ts": ts,
                 "args": {k: v for k, v in r.items() if k != "ts"},
             })
             continue
@@ -193,24 +196,120 @@ def build_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
             v = r.get(key)
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 events.append({
-                    "name": key, "ph": "C", "pid": _PID_COUNTERS,
-                    "ts": _us(ts), "args": {key: v},
+                    "name": key, "ph": "C", "pid": pid_counters,
+                    "ts": ts, "args": {key: v},
                 })
 
+    return events, pools, times
+
+
+def _rebase(events: List[Dict[str, Any]], t_base: float
+            ) -> List[Dict[str, Any]]:
+    """Absolute seconds → relative microseconds, in place."""
+    for e in events:
+        e["ts"] = round((e["ts"] - t_base) * 1e6, 3)
+        if e["ph"] == "X":
+            e["dur"] = round(e["dur"] * 1e6, 3)
+    return events
+
+
+def _meta_events(names: Dict[int, str], pools: Dict[int, List[float]],
+                 used_pids) -> List[Dict[str, Any]]:
     meta: List[Dict[str, Any]] = []
-    names = {_PID_SPANS: "process spans", _PID_REQUESTS: "serve requests",
-             _PID_COUNTERS: "metrics"}
-    used_pids = {e["pid"] for e in events}
     for pid in sorted(used_pids):
         meta.append({"name": "process_name", "ph": "M", "pid": pid,
                      "args": {"name": names.get(pid, f"pid {pid}")}})
-    for pid, pool in pools.items():
-        for tid in range(len(pool)):
+    for pid in sorted(pools):
+        for tid in range(len(pools[pid])):
             meta.append({"name": "thread_name", "ph": "M", "pid": pid,
                          "tid": tid, "args": {"name": f"track {tid}"}})
+    return meta
 
+
+def build_trace(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Records (any mix of spans / train / serve / launch / alert lines)
+    → a Trace Event Format object. Pure function of its input: no clock
+    reads, so identical records yield an identical trace."""
+    events, pools, times = _shard_events(
+        records, _PID_SPANS, _PID_REQUESTS, _PID_COUNTERS)
+    t_base = min(times) if times else 0.0
+    events = _rebase(events, t_base)
+    names = {_PID_SPANS: "process spans", _PID_REQUESTS: "serve requests",
+             _PID_COUNTERS: "metrics"}
+    meta = _meta_events(names, pools, {e["pid"] for e in events})
     events.sort(key=lambda e: (e.get("ts", 0.0), -e.get("dur", 0.0)))
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def _flow_events(events: List[Dict[str, Any]], start_id: int = 1
+                 ) -> List[Dict[str, Any]]:
+    """Cross-process flow arrows stitching one distributed request's
+    spans into a chain: for every ``trace_id`` carried by a request-level
+    X event (the router's ``fleet.request``, each replica's
+    ``serve.request`` attempt), consecutive spans on DIFFERENT pids get
+    an ``s``→``f`` pair — the Perfetto arrow from router submit to first
+    attempt, and from an evacuated attempt to its re-placement."""
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = str(e.get("name", ""))
+        if name != "fleet.request" \
+                and not name.startswith(_REQUEST_PREFIX):
+            continue
+        trace_id = (e.get("args") or {}).get("trace_id")
+        if isinstance(trace_id, str):
+            by_trace.setdefault(trace_id, []).append(e)
+    flows: List[Dict[str, Any]] = []
+    fid = start_id
+    for trace_id in sorted(by_trace):
+        chain = sorted(by_trace[trace_id],
+                       key=lambda e: (e["ts"], e["pid"], e["tid"]))
+        for a, b in zip(chain, chain[1:]):
+            if a["pid"] == b["pid"]:
+                continue
+            common = {"name": f"trace/{trace_id}", "cat": "flow",
+                      "id": fid}
+            flows.append(dict(common, ph="s", pid=a["pid"],
+                              tid=a["tid"], ts=a["ts"]))
+            flows.append(dict(common, ph="f", bp="e", pid=b["pid"],
+                              tid=b["tid"], ts=b["ts"]))
+            fid += 1
+    return flows
+
+
+def build_fleet_trace(shards: List[Tuple[str, List[Dict[str, Any]]]]
+                      ) -> Dict[str, Any]:
+    """Merge per-process record shards — ``[(name, records)]``, one per
+    router/replica — into ONE Trace Event Format object. Each shard gets
+    its own pid block (spans / requests / counters) named after it; all
+    shards share one time base (every in-process clock is the same
+    ``time.monotonic``, and wall ``ts`` stamps anchor cross-process
+    shards), so one request's hops line up on a single zoomable
+    timeline, linked by flow arrows (:func:`_flow_events`)."""
+    all_events: List[Dict[str, Any]] = []
+    all_pools: Dict[int, List[float]] = {}
+    names: Dict[int, str] = {}
+    times: List[float] = []
+    for i, (name, records) in enumerate(shards):
+        base = 3 * i
+        events, pools, ts = _shard_events(
+            records, base + _PID_SPANS, base + _PID_REQUESTS,
+            base + _PID_COUNTERS)
+        all_events.extend(events)
+        all_pools.update(pools)
+        names[base + _PID_SPANS] = f"{name} spans"
+        names[base + _PID_REQUESTS] = f"{name} requests"
+        names[base + _PID_COUNTERS] = f"{name} metrics"
+        times.extend(ts)
+    t_base = min(times) if times else 0.0
+    all_events = _rebase(all_events, t_base)
+    flows = _flow_events(all_events)
+    meta = _meta_events(names, all_pools,
+                        {e["pid"] for e in all_events})
+    all_events.extend(flows)
+    all_events.sort(key=lambda e: (e.get("ts", 0.0), -e.get("dur", 0.0)))
+    return {"traceEvents": meta + all_events, "displayTimeUnit": "ms"}
 
 
 def validate_trace(trace: Any) -> List[str]:
@@ -245,7 +344,11 @@ def validate_trace(trace: Any) -> List[str]:
                 continue
             tracks.setdefault((e.get("pid"), e.get("tid")), []).append(
                 (float(ts), float(ts) + float(dur)))
-    eps = 0.5  # µs — below the 6-decimal resolution of the JSONL fields
+    # Abutting sibling spans (queue → prefill → decode share their
+    # boundary timestamps) have t0_s and dur_s rounded independently to
+    # 6 decimals in the JSONL, so their rendered edges can disagree by
+    # up to ~1.5 µs without any real mis-nesting.
+    eps = 2.0
     for key, ivals in tracks.items():
         ivals.sort(key=lambda p: (p[0], -p[1]))
         stack: List[float] = []
@@ -278,5 +381,63 @@ def export_trace(path: str, out_path: str) -> Dict[str, Any]:
         "skipped_lines": skipped,
         "events": len(trace["traceEvents"]),
         "spans": n_spans,
+        "problems": problems,
+    }
+
+
+def fleet_trace_shards(root: str
+                       ) -> Tuple[List[Tuple[str, List[Dict[str, Any]]]],
+                                  List[str], int]:
+    """Discover a fleet run's trace shards: ``*.jsonl`` files directly
+    at ``root`` form the ``router`` shard (the router's fleet.request
+    spans and signal snapshots live at the fleet root, owning no
+    replica), and every per-replica run dir is its own shard. Returns
+    (shards, files, skipped_lines)."""
+    from .report import fleet_replica_dirs
+
+    if not os.path.isdir(root):
+        raise FileNotFoundError(f"no fleet run directory at {root}")
+    shards: List[Tuple[str, List[Dict[str, Any]]]] = []
+    files: List[str] = []
+    skipped = 0
+    router_records: List[Dict[str, Any]] = []
+    for f in sorted(os.listdir(root)):
+        if not f.endswith(".jsonl"):
+            continue
+        recs, fs, sk = collect(os.path.join(root, f))
+        router_records.extend(recs)
+        files.extend(fs)
+        skipped += sk
+    if router_records:
+        shards.append(("router", router_records))
+    for name, sub in fleet_replica_dirs(root):
+        recs, fs, sk = collect(sub)
+        shards.append((name, recs))
+        files.extend(fs)
+        skipped += sk
+    return shards, files, skipped
+
+
+def export_fleet_trace(root: str, out_path: str) -> Dict[str, Any]:
+    """Merge every shard under a fleet root into one ``trace.json``
+    (see :func:`build_fleet_trace`); returns the summary dict with the
+    per-shard breakdown and the cross-process ``flow_events`` count the
+    smoke gate asserts on."""
+    shards, files, skipped = fleet_trace_shards(root)
+    trace = build_fleet_trace(shards)
+    problems = validate_trace(trace)
+    with open(out_path, "w") as fh:
+        json.dump(trace, fh)
+    n_spans = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+    n_flows = sum(1 for e in trace["traceEvents"] if e.get("ph") == "s")
+    return {
+        "out": out_path,
+        "shards": [name for name, _ in shards],
+        "records": sum(len(recs) for _, recs in shards),
+        "files": len(files),
+        "skipped_lines": skipped,
+        "events": len(trace["traceEvents"]),
+        "spans": n_spans,
+        "flow_events": n_flows,
         "problems": problems,
     }

@@ -168,7 +168,8 @@ _RESERVOIR_SEED = 0x5EED
 
 
 class _HistSeries:
-    __slots__ = ("bucket_counts", "count", "total", "samples", "rng")
+    __slots__ = ("bucket_counts", "count", "total", "samples", "rng",
+                 "first_ts", "last_ts")
 
     def __init__(self, n_buckets: int):
         self.bucket_counts = [0] * (n_buckets + 1)  # +1 for +Inf
@@ -176,6 +177,11 @@ class _HistSeries:
         self.total = 0.0
         self.samples: List[float] = []
         self.rng: Optional[random.Random] = None  # created at first evict
+        # Observation window bounds — set only from caller-supplied
+        # timestamps (observe(ts=...)); the metrics path itself never
+        # reads a clock, per the determinism rule above.
+        self.first_ts: Optional[float] = None
+        self.last_ts: Optional[float] = None
 
 
 class Histogram(_Instrument):
@@ -213,7 +219,12 @@ class Histogram(_Instrument):
             self.max_samples = max_samples
             self._series: Dict[LabelKey, _HistSeries] = {}
 
-    def observe(self, v: float, **labels: str) -> None:
+    def observe(self, v: float, ts: Optional[float] = None,
+                **labels: str) -> None:
+        """Record one observation. ``ts`` (optional, caller-supplied —
+        never read from a clock here) stamps the series' observation
+        window so windowed percentiles can report how much wall time
+        backs them."""
         key = _label_key({**dict(self._bound), **labels})
         root = self._root()
         v = float(v)
@@ -221,6 +232,12 @@ class Histogram(_Instrument):
             s = root._series.get(key)
             if s is None:
                 s = root._series[key] = _HistSeries(len(root.buckets))
+            if ts is not None:
+                ts = float(ts)
+                if s.first_ts is None or ts < s.first_ts:
+                    s.first_ts = ts
+                if s.last_ts is None or ts > s.last_ts:
+                    s.last_ts = ts
             i = 0
             for i, b in enumerate(root.buckets):
                 if v <= b:
@@ -331,6 +348,14 @@ class MetricsRegistry:
                         "sum": s.total,
                         "p50": percentile(s.samples, 50),
                         "p95": percentile(s.samples, 95),
+                        # Honesty fields: how many raw samples actually
+                        # back the percentiles (== count until the
+                        # reservoir cap bites) and the observation
+                        # window they were taken over (None when the
+                        # caller supplied no timestamps).
+                        "samples_retained": len(s.samples),
+                        "window_start_ts": s.first_ts,
+                        "window_end_ts": s.last_ts,
                     }
             out[inst.name] = {"kind": inst.kind, "series": series}
         return out

@@ -339,62 +339,44 @@ def summarize_fleet(root: str) -> Dict[str, Any]:
     sections are full :func:`summarize` outputs; the ``fleet`` section
     is the aggregate an operator triages from — total tokens/sec across
     replicas, the WORST p95 request latency (the fleet is as slow as
-    its slowest replica), and the total alert count."""
+    its slowest replica), and the total alert count.
+
+    The aggregate is computed by replaying every replica's records
+    through the :class:`~.signals.SignalBus` — the same fold
+    ``obs tail --fleet`` and the autoscale controller consume — so the
+    live and post-hoc views can never drift apart. The full
+    signal-snapshot rides along under ``"signals"``."""
+    from .signals import SignalBus
+
     dirs = fleet_replica_dirs(root)
     replicas: Dict[str, Any] = {}
     total_records = 0
-    tok_rates, worst_p95, alert_count = [], [], 0
-    tokens_total = 0
-    submitted = completed = rejected = 0
-    attempts, restarts, launch_fail = 0, 0, []
+    bus = SignalBus(names=[name for name, _ in dirs])
     for name, path in dirs:
         s = summarize(path)
         replicas[name] = s
         total_records += s["source"]["records"]
-        sv = s.get("serve")
-        if sv:
-            if isinstance(sv.get("tokens_per_sec"), (int, float)):
-                tok_rates.append(sv["tokens_per_sec"])
-            if isinstance(sv.get("tokens_generated"), (int, float)):
-                tokens_total += sv["tokens_generated"]
-            p95 = sv.get("latency_s", {}).get("p95")
-            if isinstance(p95, (int, float)):
-                worst_p95.append(p95)
-            for key, bucket in (("submitted", "submitted"),
-                                ("completed", "completed"),
-                                ("rejected", "rejected")):
-                v = sv.get(key)
-                if isinstance(v, (int, float)):
-                    if bucket == "submitted":
-                        submitted += v
-                    elif bucket == "completed":
-                        completed += v
-                    else:
-                        rejected += v
-        if s.get("alerts"):
-            alert_count += s["alerts"]["count"]
-        la = s.get("launch")
-        if la:
-            attempts += la["attempts"]
-            restarts += la["restarts"]
-            if not la["success"]:
-                launch_fail.append(name)
+        records, _, _ = collect(path)
+        for rec in records:
+            bus.observe(name, rec)
+    agg = bus.fleet()
     return {
         "source": {"path": root, "replicas": len(dirs),
                    "records": total_records},
         "fleet": {
-            "tokens_per_sec": round(sum(tok_rates), 2)
-            if tok_rates else None,
-            "tokens_generated": tokens_total or None,
-            "worst_latency_p95_s": max(worst_p95) if worst_p95 else None,
-            "alerts": alert_count,
-            "submitted": submitted or None,
-            "completed": completed or None,
-            "rejected": rejected or None,
-            "launch_attempts": attempts or None,
-            "launch_restarts": restarts,
-            "launch_failed_replicas": launch_fail,
+            "tokens_per_sec": round(agg["tokens_per_sec"], 2)
+            if isinstance(agg["tokens_per_sec"], (int, float)) else None,
+            "tokens_generated": agg["tokens_generated"] or None,
+            "worst_latency_p95_s": agg["worst_latency_p95_s"],
+            "alerts": agg["alerts"],
+            "submitted": agg["submitted"] or None,
+            "completed": agg["completed"] or None,
+            "rejected": agg["rejected"] or None,
+            "launch_attempts": agg["launch_attempts"] or None,
+            "launch_restarts": agg["launch_restarts"],
+            "launch_failed_replicas": agg["launch_failed_replicas"],
         },
+        "signals": bus.snapshot(),
         "replicas": replicas,
     }
 

@@ -32,6 +32,22 @@ Three kinds:
 - ``drop`` — rate-of-change guard for higher-is-better series: fires
   when the value falls more than ``max_drop_frac`` below the running
   peak, after ``warmup`` observations have established one.
+- ``phase_budget`` — a latency SLO decomposed into per-phase budgets:
+
+      {"name": "request-p95", "kind": "phase_budget",
+       "metric": "serve_latency_p95_s", "max": 1.0,
+       "phases": {
+         "prefill": {"metric": "serve_phase_prefill_p95_s",
+                     "budget": 0.2},
+         "decode": {"metric": "serve_phase_decode_p95_s",
+                    "budget": 0.7}}}
+
+  fires exactly like ``threshold`` on ``metric`` > ``max``, but the
+  alert carries a ``phase`` attribution: the phase whose last observed
+  metric most exceeds its budget (largest observed/budget ratio above
+  1), or ``"unattributed"`` when the total blew up with every phase
+  inside budget — so `obs check` says WHICH stage of the request to go
+  look at, not just that the p95 is bad.
 
 Alerts are **edge-triggered**: a rule that stays in breach emits one
 alert at the ok→breach transition (and re-arms after recovering), so a
@@ -46,7 +62,7 @@ from typing import Any, Dict, List, Optional
 from .metrics import percentile
 from .report import collect
 
-KINDS = ("threshold", "percentile", "drop")
+KINDS = ("threshold", "percentile", "drop", "phase_budget")
 
 
 class RuleError(ValueError):
@@ -82,12 +98,34 @@ class Rule:
                 raise RuleError(
                     f"rule {self.name!r}: drop rules need 'max_drop_frac'")
             self.max_drop_frac = float(self.max_drop_frac)
+        self.phases: Dict[str, Dict[str, Any]] = {}
+        if self.kind == "phase_budget":
+            if self.max is None:
+                raise RuleError(
+                    f"rule {self.name!r}: phase_budget rules need 'max'")
+            phases = spec.get("phases")
+            if not isinstance(phases, dict) or not phases:
+                raise RuleError(
+                    f"rule {self.name!r}: phase_budget rules need a "
+                    f"non-empty 'phases' object")
+            for pname, p in phases.items():
+                if not isinstance(p, dict) \
+                        or not isinstance(p.get("metric"), str) \
+                        or not isinstance(p.get("budget"), (int, float)) \
+                        or isinstance(p.get("budget"), bool) \
+                        or p["budget"] <= 0:
+                    raise RuleError(
+                        f"rule {self.name!r}: phase {pname!r} needs a "
+                        f"'metric' string and a positive 'budget'")
+                self.phases[str(pname)] = {
+                    "metric": p["metric"], "budget": float(p["budget"])}
         # Streaming state.
         self.breached = False       # edge-trigger latch
         self.fired = 0              # total ok→breach transitions
         self._samples: List[float] = []
         self._peak: Optional[float] = None
         self._seen = 0
+        self._phase_last: Dict[str, float] = {}
 
     def _evaluate(self, v: float) -> Optional[Dict[str, Any]]:
         """None when within SLO; otherwise {value, limit, detail}."""
@@ -115,6 +153,30 @@ class Rule:
                                   f"< min {self.min} "
                                   f"over {len(self._samples)} samples"}
             return None
+        if self.kind == "phase_budget":
+            if v <= self.max:
+                return None
+            worst: Optional[str] = None
+            worst_ratio = 0.0
+            for pname, p in sorted(self.phases.items()):
+                last = self._phase_last.get(pname)
+                if last is None:
+                    continue
+                ratio = last / p["budget"]
+                if ratio > worst_ratio:
+                    worst, worst_ratio = pname, ratio
+            if worst is not None and worst_ratio > 1.0:
+                phase = worst
+                why = (f"{phase} at "
+                       f"{self._phase_last[phase]:.6g}s of "
+                       f"{self.phases[phase]['budget']:g}s budget "
+                       f"({worst_ratio:.2f}x)")
+            else:
+                phase = "unattributed"
+                why = "every phase within budget"
+            return {"value": v, "limit": self.max, "phase": phase,
+                    "detail": f"{self.metric}={v:.6g} > max {self.max}; "
+                              f"blown phase: {why}"}
         # drop
         self._seen += 1
         prev_peak = self._peak
@@ -132,6 +194,15 @@ class Rule:
         return None
 
     def observe(self, record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        if self.kind == "phase_budget":
+            # Phase metrics ride in the same stream; remember the last
+            # observation of each so a breach can be attributed even
+            # when the total and the phases arrive in separate records.
+            for pname, p in self.phases.items():
+                pv = record.get(p["metric"])
+                if isinstance(pv, (int, float)) \
+                        and not isinstance(pv, bool):
+                    self._phase_last[pname] = float(pv)
         v = record.get(self.metric)
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             return None

@@ -165,16 +165,19 @@ class TailState:
 
 
 class FleetTailState:
-    """Per-replica :class:`TailState`s folded into ONE fleet status
+    """A :class:`~.signals.SignalBus` folded live into ONE fleet status
     line: total tokens/sec and queue depth across replicas, aggregate
     done/submitted, the WORST per-replica latency p95, total alerts —
-    the same aggregate `obs summarize --fleet` reports, live."""
+    the same aggregate `obs summarize --fleet` reports, because both
+    read the identical bus fold."""
 
     def __init__(self, names: List[str]):
-        self.states: Dict[str, TailState] = {n: TailState() for n in names}
+        from .signals import SignalBus
+
+        self.bus = SignalBus(names=names)
 
     def update(self, name: str, rec: Dict[str, Any]) -> None:
-        self.states[name].update(rec)
+        self.bus.observe(name, rec)
 
     def status_line(self) -> str:
         def _f(v: Any) -> str:
@@ -184,24 +187,17 @@ class FleetTailState:
                 return f"{v:.4g}"
             return str(v)
 
-        def _sum(attr):
-            vals = [getattr(s, attr) for s in self.states.values()
-                    if isinstance(getattr(s, attr), (int, float))]
-            return sum(vals) if vals else None
-
-        live = sum(1 for s in self.states.values() if s.records)
-        if live == 0:
-            return f"fleet {len(self.states)} replica(s) | (no records yet)"
-        p95s = [s.latency_p95_s for s in self.states.values()
-                if isinstance(s.latency_p95_s, (int, float))]
-        alerts = sum(s.alerts for s in self.states.values())
-        parts = [f"fleet {live}/{len(self.states)} replica(s)",
-                 f"q={_f(_sum('queue_depth'))} "
-                 f"{_f(_sum('tokens_per_sec'))} tok/s",
-                 f"done {_f(_sum('completed'))}/{_f(_sum('submitted'))}",
-                 f"worst p95 {_f(max(p95s) if p95s else None)}",
-                 f"alerts {alerts}"]
-        fails = {n: s.launch_outcome for n, s in self.states.items()
+        f = self.bus.fleet()
+        if f["replicas_live"] == 0:
+            return f"fleet {f['replicas']} replica(s) | (no records yet)"
+        parts = [f"fleet {f['replicas_live']}/{f['replicas']} replica(s)",
+                 f"q={_f(f['queue_depth'])} "
+                 f"{_f(f['tokens_per_sec'])} tok/s",
+                 f"done {_f(f['completed'])}/{_f(f['submitted'])}",
+                 f"worst p95 {_f(f['worst_latency_p95_s'])}",
+                 f"alerts {f['alerts']}"]
+        fails = {n: s.launch_outcome
+                 for n, s in self.bus.replicas.items()
                  if s.launch_outcome not in (None, "ok")}
         if fails:
             parts.append("launch " + ",".join(
@@ -251,13 +247,17 @@ def tail(path: str, interval_s: float = 1.0,
     while True:
         for name, f in pairs:
             for rec in f.poll():
-                target = fstate.states[name] if fleet else state
+                def _fold(r):
+                    if fleet:
+                        fstate.update(name, r)
+                    else:
+                        state.update(r)
                 if slo_engine is not None and rec.get("event") != "alert":
                     for alert in slo_engine.observe(rec):
-                        target.update(alert)
+                        _fold(alert)
                         print(f"ALERT {alert['rule']}: "
                               f"{alert.get('detail', '')}", file=out)
-                target.update(rec)
+                _fold(rec)
         line = fstate.status_line() if fleet else state.status_line()
         if line != last_line:
             print(line, file=out)

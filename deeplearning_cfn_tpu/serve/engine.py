@@ -105,6 +105,10 @@ class _Group:
     rows: List[int]
     budget: int  # decode-step budget (< model.max_len)
     steps: int = 0
+    # Decode work actually performed for this request (row-steps that
+    # produced a token), whether or not those tokens reach the final
+    # response — the goodput/waste ledger's denominator.
+    decoded: int = 0
     # Beam-search state (beam_size > 1): replicates beam_decode_cached's
     # carry. beam_tokens column 0 is BOS, column t+1 the step-t choice.
     scores: Optional[np.ndarray] = None
@@ -191,6 +195,9 @@ class Engine:
                                   retry_after_floor_s=retry_after_floor_s)
         self.metrics = metrics if metrics is not None \
             else ServeMetrics(capacity, clock=clock)
+        # The phase ledger + goodput accounting is always on for engine
+        # requests (bare ServeMetrics instances keep the base surface).
+        self.metrics.configure_request_ledger()
 
         # Speculative decoding (Leviathan et al.): a draft model proposes
         # speculate_gamma tokens per row autoregressively, the target
@@ -402,7 +409,8 @@ class Engine:
     def submit(self, src_ids: List[int],
                max_new_tokens: Optional[int] = None, beam_size: int = 1,
                deadline_s: Optional[float] = None,
-               request_id: Optional[str] = None) -> Request:
+               request_id: Optional[str] = None,
+               trace_id: Optional[str] = None) -> Request:
         """Validate + enqueue. Raises OverloadError when the queue is full,
         ValueError on requests the engine could never place."""
         if not src_ids:
@@ -427,7 +435,8 @@ class Engine:
         try:
             req = self.queue.submit(src_ids, budget, beam_size=beam_size,
                                     deadline_s=deadline_s,
-                                    request_id=request_id)
+                                    request_id=request_id,
+                                    trace_id=trace_id)
         except OverloadError as e:
             self.metrics.record_reject(e.retry_after_s)
             raise
@@ -577,6 +586,25 @@ class Engine:
         group.req.finished_at = now
         self._groups.remove(group)
         self.metrics.record_finish(state.value, group.req.latency_s)
+        # Goodput/waste ledger: every decoded row-step is attributed
+        # exactly once. DONE keeps its response tokens as goodput (the
+        # remainder is beam-discarded work); cancelled/expired decode
+        # work reached no response and is all waste. The invariant
+        # goodput + wasted == tokens_generated holds per drained engine.
+        kept = len(group.req.tokens)
+        if state is RequestState.DONE:
+            self.metrics.record_ledger(
+                goodput=kept, wasted=max(0, group.decoded - kept),
+                reason="beam_discard")
+        else:
+            self.metrics.record_ledger(wasted=group.decoded,
+                                       reason="preempted")
+        decode_s = None
+        if group.req.admitted_at is not None:
+            decode_s = max(
+                now - group.req.admitted_at
+                - (group.req.prefill_s or 0.0), 0.0)
+        self.metrics.record_phases(group.req.prefill_s, decode_s)
         # The request's whole lifecycle is known only now — emit it as
         # retroactive submit->admit->finish spans tagged with the request
         # id, the rows the trace exporter draws per request.
@@ -656,6 +684,18 @@ class Engine:
             self.metrics.record_admit(now - req.submitted_at)
         if not admits:
             return
+        t_prefill = self._clock()
+        try:
+            self._prefill(admits)
+        finally:
+            # The batch prefilled as one device call; each admitted
+            # request experienced the whole call as its admission-
+            # prefill phase (the ledger's prefill number).
+            dt = self._clock() - t_prefill
+            for group in admits:
+                group.req.prefill_s = dt
+
+    def _prefill(self, admits: List[_Group]) -> None:
         # Batched prefill: the encode batch is always [capacity, S] (one
         # compile, ever) — slot j encodes the source for target row
         # row_targets[j]; unused slots stay PAD with row target `capacity`,
@@ -972,6 +1012,7 @@ class Engine:
                 tok = int(tgt[r, j])
                 g.req.tokens.append(tok)
                 g.steps += 1
+                g.decoded += 1
                 new_tokens += 1
                 if g.req.first_token_at is None:
                     g.req.first_token_at = now
@@ -1073,6 +1114,7 @@ class Engine:
             for step_k in range(k):
                 g.req.tokens.append(int(tokens[step_k, r]))
                 g.steps += 1
+                g.decoded += 1
                 new_tokens += 1
                 if g.req.first_token_at is None:
                     g.req.first_token_at = now
@@ -1118,6 +1160,7 @@ class Engine:
         now = self._clock()
         for g in list(self._groups):
             new_tokens += len(g.rows)
+            g.decoded += len(g.rows)
             if g.req.beam_size == 1:
                 r = g.rows[0]
                 nxt = int(np.argmax(logits[r]))

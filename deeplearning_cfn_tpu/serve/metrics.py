@@ -93,6 +93,10 @@ class ServeMetrics:
         self._spec_emitted = None
         self._spec_target_steps = None
         self._spec_accept_rate = None
+        self._goodput = None
+        self._waste = None
+        self._phase_prefill = None
+        self._phase_decode = None
 
     # -- optional feature surfaces -----------------------------------------
 
@@ -134,6 +138,48 @@ class ServeMetrics:
         self._spec_accept_rate = r.histogram(
             "serve_spec_accept_rate",
             "per-row accepted/proposed fraction per spec call")
+
+    def configure_request_ledger(self) -> None:
+        """Enable the per-request phase ledger + goodput surface
+        (serve_phase_*, serve_goodput_*, serve_wasted_*). The engine
+        turns this on unconditionally; bare ServeMetrics instances (and
+        their exact-key snapshot contract) are unchanged."""
+        r = self.registry
+        self._goodput = r.counter(
+            "serve_goodput_tokens_total",
+            "decoded tokens that reached a completed (DONE) response")
+        self._waste = r.counter(
+            "serve_wasted_tokens_total",
+            "decoded tokens that reached no response, by reason")
+        self._phase_prefill = r.histogram(
+            "serve_phase_prefill_s", "admission prefill device time")
+        self._phase_decode = r.histogram(
+            "serve_phase_decode_s", "prefill-end to finish")
+
+    def record_ledger(self, goodput: int = 0, wasted: int = 0,
+                      reason: str = "preempted") -> None:
+        """Account one released request's decoded tokens: ``goodput``
+        reached the response, ``wasted`` did not (``reason`` labels why:
+        beam_discard, preempted). goodput + wasted must equal the
+        tokens the engine decoded for the request — the sum contract
+        ``bench --fleet`` asserts."""
+        if self._goodput is None:
+            return
+        if goodput:
+            self._goodput.inc(goodput)
+        if wasted:
+            self._waste.inc(wasted, reason=reason)
+
+    def record_phases(self, prefill_s: Optional[float],
+                      decode_s: Optional[float]) -> None:
+        """Observe one finished request's prefill/decode phase durations
+        (None skips — e.g. a request cancelled before admission)."""
+        if self._phase_prefill is None:
+            return
+        if isinstance(prefill_s, (int, float)):
+            self._phase_prefill.observe(max(float(prefill_s), 0.0))
+        if isinstance(decode_s, (int, float)):
+            self._phase_decode.observe(max(float(decode_s), 0.0))
 
     def record_spec(self, proposed: int, accepted: int,
                     target_row_steps: int, emitted: int,
@@ -204,9 +250,12 @@ class ServeMetrics:
             return
         tracer = get_tracer()
         state = getattr(req, "state", None)
+        rid = getattr(req, "id", None)
+        trace_id = getattr(req, "trace_id", None) or rid
         parent = tracer.record_span(
             "serve.request", t0, max(t_end - t0, 0.0),
-            request_id=getattr(req, "id", None),
+            request_id=rid,
+            trace_id=trace_id,
             state=getattr(state, "value", state),
             beam_size=getattr(req, "beam_size", 1),
             tokens=len(getattr(req, "tokens", ()) or ()),
@@ -217,11 +266,20 @@ class ServeMetrics:
         if isinstance(t_admit, (int, float)):
             tracer.record_span(
                 "serve.request.queue", t0, max(t_admit - t0, 0.0),
-                parent_id=parent, request_id=getattr(req, "id", None))
+                parent_id=parent, request_id=rid)
+            prefill_s = getattr(req, "prefill_s", None)
+            t_decode = t_admit
+            if isinstance(prefill_s, (int, float)) and prefill_s > 0:
+                prefill_s = min(max(float(prefill_s), 0.0),
+                                max(t_end - t_admit, 0.0))
+                tracer.record_span(
+                    "serve.request.prefill", t_admit, prefill_s,
+                    parent_id=parent, request_id=rid)
+                t_decode = t_admit + prefill_s
             tracer.record_span(
-                "serve.request.decode", t_admit,
-                max(t_end - t_admit, 0.0), parent_id=parent,
-                request_id=getattr(req, "id", None),
+                "serve.request.decode", t_decode,
+                max(t_end - t_decode, 0.0), parent_id=parent,
+                request_id=rid,
                 ttft_s=getattr(req, "ttft_s", None))
 
     def record_step(self, active_rows: float, queue_depth: int,
@@ -409,6 +467,27 @@ class ServeMetrics:
             return None
         return self._spec_emitted.value() / steps
 
+    @property
+    def goodput_tokens(self) -> int:
+        if self._goodput is None:
+            return 0
+        return int(self._goodput.value())
+
+    @property
+    def wasted_tokens(self) -> int:
+        """Total decoded-but-unused tokens across waste reasons."""
+        if self._waste is None:
+            return 0
+        return int(sum(self._waste.series().values()))
+
+    @property
+    def wasted_draft_tokens(self) -> int:
+        """Rejected speculation drafts. Tracked separately from
+        :attr:`wasted_tokens`: draft proposals never enter
+        ``tokens_generated`` (only emitted tokens do), so they sit
+        outside the goodput + wasted == decoded sum contract."""
+        return max(0, self.spec_proposed - self.spec_accepted)
+
     def snapshot(self) -> Dict:
         snap = {
             "serve_submitted": self.submitted,
@@ -466,6 +545,18 @@ class ServeMetrics:
                 self._spec_accept_rate.percentile(95)
             snap["serve_spec_tokens_per_target_step"] = \
                 self.spec_tokens_per_target_step
+        if self._goodput is not None:
+            snap["serve_goodput_tokens"] = self.goodput_tokens
+            snap["serve_wasted_tokens"] = self.wasted_tokens
+            snap["serve_wasted_draft_tokens"] = self.wasted_draft_tokens
+            snap["serve_phase_prefill_p50_s"] = \
+                self._phase_prefill.percentile(50)
+            snap["serve_phase_prefill_p95_s"] = \
+                self._phase_prefill.percentile(95)
+            snap["serve_phase_decode_p50_s"] = \
+                self._phase_decode.percentile(50)
+            snap["serve_phase_decode_p95_s"] = \
+                self._phase_decode.percentile(95)
         return snap
 
     def emit(self, writer: MetricsWriter, **extra) -> None:

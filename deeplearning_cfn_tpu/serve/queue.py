@@ -84,6 +84,14 @@ class Request:
     finished_at: Optional[float] = None
     tokens: List[int] = field(default_factory=list)
     cancel_requested: bool = False
+    # Distributed trace context: the fleet-level request identity minted
+    # at Router.submit. Stable across evacuation/rollout re-routes while
+    # ``id`` is the per-replica attempt id (``<trace>#aN``). None for
+    # requests submitted straight to an engine.
+    trace_id: Optional[str] = None
+    # Admission-prefill device time attributed to this request (set by
+    # the engine's batched prefill; feeds the per-request phase ledger).
+    prefill_s: Optional[float] = None
 
     @property
     def finished(self) -> bool:
@@ -160,7 +168,8 @@ class RequestQueue:
 
     def submit(self, src_ids: List[int], max_new_tokens: int,
                beam_size: int = 1, deadline_s: Optional[float] = None,
-               request_id: Optional[str] = None) -> Request:
+               request_id: Optional[str] = None,
+               trace_id: Optional[str] = None) -> Request:
         """Enqueue a request or raise :class:`OverloadError`."""
         if max_new_tokens <= 0:
             raise ValueError("max_new_tokens must be positive")
@@ -189,7 +198,7 @@ class RequestQueue:
                 id=rid, src_ids=list(src_ids),
                 max_new_tokens=max_new_tokens, beam_size=beam_size,
                 deadline=None if deadline_s is None else now + deadline_s,
-                submitted_at=now)
+                submitted_at=now, trace_id=trace_id)
             self._pending.append(req)
             self._by_id[rid] = req
             return req

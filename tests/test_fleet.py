@@ -11,6 +11,7 @@ upgrade and through a chaos kill.
 """
 
 import io
+import itertools
 import json
 import os
 import sys
@@ -80,7 +81,7 @@ class FakeEngine:
         return len(self._running)
 
     def submit(self, src_ids, max_new_tokens=None, beam_size=1,
-               deadline_s=None, request_id=None):
+               deadline_s=None, request_id=None, trace_id=None):
         if self.queue.depth >= self.queue.max_depth:
             raise OverloadError(self.queue.depth, self.queue.max_depth,
                                 retry_after_s=self.retry_after)
@@ -88,7 +89,7 @@ class FakeEngine:
             else f"fake-{len(self._by_id)}"
         req = Request(id=rid, src_ids=list(src_ids),
                       max_new_tokens=max_new_tokens or 4,
-                      beam_size=beam_size)
+                      beam_size=beam_size, trace_id=trace_id)
         self.queue.items.append(req)
         self._by_id[rid] = req
         return req
@@ -427,6 +428,14 @@ def test_supervisor_restarts_crash_within_budget(tmp_path):
            if e.get("event") == "launch_attempt"]
     assert [e["outcome"] for e in evs] == ["crash", "ok"]
     assert [e["attempt"] for e in evs] == [0, 1]
+    # Each attempt also leaves a launch.attempt span in the same stream,
+    # carrying the hang-vs-crash classification as a span attribute.
+    spans = [e for e in _launch_events(tmp_path, "replica-0")
+             if e.get("span") == "launch.attempt"]
+    assert [s["outcome"] for s in spans] == ["crash", "ok"]
+    assert [s["ok"] for s in spans] == [False, True]
+    assert [s["attempt"] for s in spans] == [0, 1]
+    assert all(s["dur_s"] >= 0.0 for s in spans)
 
 
 def test_supervisor_gives_up_after_restart_budget(tmp_path):
@@ -746,6 +755,15 @@ def test_e2e_chaos_kill_mid_decode_token_parity(tiny_fleet_setup):
     assert all(r["state"] == "done" for r in results)
     assert router.stats()["dropped_requests"] == 0
     assert [r["tokens"] for r in results] == s["baseline"]
+    # Goodput accounting across the kill: every decoded token is either
+    # in a DONE result (goodput) or was decoded on the abandoned attempt
+    # (waste) — the two sum to the fleet's decoded total, exactly.
+    st = router.stats()
+    total_decoded = sum(
+        router.replica(r).engine.metrics.tokens_generated
+        for r in router.replica_ids())
+    assert st["goodput_tokens"] + st["wasted_tokens"] == total_decoded
+    assert st["goodput_tokens"] == sum(len(r["tokens"]) for r in results)
 
 
 def test_fleet_bench_smoke_contract_record():
@@ -766,4 +784,150 @@ def test_fleet_bench_smoke_contract_record():
         assert row["state"] == "healthy"
         assert row["routed"] > 0
     assert sum(r["tokens"] for r in rec["per_replica"]) > 0
+    # The goodput ledger fields: goodput + waste == decoded, exactly.
+    assert rec["goodput_sum_ok"] is True
+    total = sum(r["tokens"] for r in rec["per_replica"])
+    assert rec["goodput_tokens"] + rec["wasted_tokens"] == total
+    assert rec["e2e_latency_p50_s"] is not None
+    assert rec["e2e_latency_p95_s"] >= rec["e2e_latency_p50_s"]
+    assert rec["goodput_tokens_per_sec"] is not None
+    assert rec["goodput_tokens_per_sec"] > 0
     assert json.dumps(rec)   # one JSON line, like every bench record
+
+
+# -- request tracing & the goodput ledger ------------------------------------
+
+
+def test_trace_id_stable_across_crash_evacuation():
+    """The per-attempt replica request id changes on re-placement (so a
+    re-placed copy can never collide with a cancelled one) but the trace
+    context — ``Request.trace_id`` == the logical rid — rides along
+    unchanged, which is what lets the exporter stitch both attempts into
+    one flow."""
+    plan = FaultPlan([FaultSpec(op="step", key="replica-0", kind="crash",
+                                at_calls=(1,))])
+    reps = [_fake_replica("replica-0", work=3, fault_plan=plan),
+            _fake_replica("replica-1", work=3)]
+    router = Router(reps, policy="round_robin")
+    rid = router.submit([5, 4, 3], max_new_tokens=3)
+    first = router.poll(rid)
+    assert first.id == f"{rid}#a1" and first.trace_id == rid
+    router.step()                    # decodes one token on replica-0
+    router.step()                    # injected crash -> evacuation
+    second = router.poll(rid)
+    assert second.id == f"{rid}#a2"  # fresh per-attempt id...
+    assert second.trace_id == rid    # ...same trace context
+    router.run_until_drained()
+    assert router.result(rid)["state"] == "done"
+    entry = router.ledger[rid]
+    assert entry["replicas"] == ["replica-0", "replica-1"]
+    assert entry["attempts"] == 2
+    assert entry["goodput_tokens"] == 3 and entry["wasted_tokens"] == 1
+    assert set(entry["phases"]) == {"queue_wait_s", "prefill_s",
+                                    "decode_s", "stall_s", "emit_s"}
+    st = router.stats()
+    assert st["goodput_tokens"] == 3 and st["wasted_tokens"] == 1
+
+
+def test_trace_id_and_waste_across_forced_evacuation():
+    """Same contract through the rollout path: drain + evacuate (the
+    drain-deadline escape hatch) abandons a half-decoded attempt — its
+    tokens are waste, the re-placed copy keeps the trace id, and the
+    final result is whole."""
+    reps = [_fake_replica("replica-0", work=5),
+            _fake_replica("replica-1", work=5)]
+    router = Router(reps, policy="round_robin")
+    rid = router.submit([5, 4, 3], max_new_tokens=5)
+    router.step()                    # one token decoded on replica-0
+    router.drain("replica-0")
+    router.evacuate("replica-0")
+    req = router.poll(rid)
+    assert req.id == f"{rid}#a2" and req.trace_id == rid
+    router.run_until_drained()
+    result = router.result(rid)
+    assert result["state"] == "done" and len(result["tokens"]) == 5
+    entry = router.ledger[rid]
+    assert entry["replicas"] == ["replica-0", "replica-1"]
+    assert entry["goodput_tokens"] == 5 and entry["wasted_tokens"] == 1
+    st = router.stats()
+    assert st["goodput_tokens"] == 5 and st["wasted_tokens"] == 1
+    assert st["dropped_requests"] == 0
+
+
+def test_stall_time_accrues_while_backlogged():
+    """A request evacuated with nowhere to go waits in the backlog; the
+    gap between losing its replica copy and the re-placement is stall
+    time in its phase ledger (deterministic under an injected clock)."""
+    ticks = itertools.count()
+    reps = [_fake_replica("replica-0", work=3, capacity=2, queue_depth=8),
+            _fake_replica("replica-1", work=3, capacity=1, queue_depth=1)]
+    router = Router(reps, policy="round_robin",
+                    clock=lambda: float(next(ticks)))
+    a = router.submit([5, 4, 3], max_new_tokens=3)   # -> replica-0
+    b = router.submit([5, 4, 3], max_new_tokens=3)   # -> replica-1 (full)
+    router.drain("replica-0")
+    router.evacuate("replica-0")     # a: survivor is full -> backlog
+    assert router.poll(a) is None    # no live copy anywhere
+    router.run_until_drained()
+    results = [router.result(r) for r in (a, b)]
+    assert all(r["state"] == "done" for r in results)
+    entry = router.ledger[a]
+    # attempts counts every placement TRY (overload rejections included);
+    # the request actually lived on exactly two replicas.
+    assert entry["attempts"] >= 2
+    assert entry["replicas"] == ["replica-0", "replica-1"]
+    assert entry["phases"]["stall_s"] > 0.0
+    assert entry["e2e_s"] is not None
+    assert router.stats()["dropped_requests"] == 0
+
+
+def test_fleet_chaos_trace_merges_with_flow_links(tmp_path):
+    """The tracing acceptance contract, end to end: a chaos fleet bench
+    writes per-process trace shards; `obs export --fleet` merges them
+    into ONE valid Perfetto timeline where a single logical request's
+    spans appear on the router AND >= 2 replicas, linked by
+    cross-process flow events."""
+    from deeplearning_cfn_tpu.fleet.bench import run_fleet_bench
+    from deeplearning_cfn_tpu.obs.export import export_fleet_trace
+
+    trace_dir = str(tmp_path / "fleet-trace")
+    rec = run_fleet_bench(smoke=True, chaos_kill_step=2,
+                          trace_dir=trace_dir)
+    assert rec["dropped_requests"] == 0
+    assert rec["goodput_sum_ok"] is True
+    assert rec["trace_dir"] == trace_dir
+    assert os.path.exists(os.path.join(trace_dir, "router.jsonl"))
+    assert os.path.exists(os.path.join(trace_dir, "signals.jsonl"))
+
+    out = str(tmp_path / "trace.json")
+    summary = export_fleet_trace(trace_dir, out)
+    assert summary["problems"] == []
+    assert summary["shards"] == ["router", "replica-0", "replica-1"]
+    assert summary["flow_events"] >= 1
+
+    with open(out) as fh:
+        evs = json.load(fh)["traceEvents"]
+    pids_by_trace = {}
+    for e in evs:
+        name = str(e.get("name", ""))
+        if e.get("ph") != "X" or not (
+                name == "fleet.request" or name.startswith("serve.request")):
+            continue
+        trace_id = (e.get("args") or {}).get("trace_id")
+        if isinstance(trace_id, str):
+            pids_by_trace.setdefault(trace_id, set()).add(e["pid"])
+    # Every routed request has spans on >= 2 pid blocks (router + the
+    # replica that served it); the evacuated ones hop, so at least one
+    # request shows on >= 3 (router + both replicas).
+    assert pids_by_trace
+    assert all(len(p) >= 2 for p in pids_by_trace.values())
+    assert any(len(p) >= 3 for p in pids_by_trace.values())
+    # Flow events come in s/f pairs sharing an id, each bound to a slice.
+    starts = [e for e in evs if e.get("ph") == "s"]
+    finishes = [e for e in evs if e.get("ph") == "f"]
+    assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+    assert all(e.get("bp") == "e" for e in finishes)
+    for s, f in zip(sorted(starts, key=lambda e: e["id"]),
+                    sorted(finishes, key=lambda e: e["id"])):
+        assert s["pid"] != f["pid"]      # cross-process by construction
+        assert f["ts"] >= s["ts"]

@@ -1208,3 +1208,163 @@ def test_cli_obs_summarize_empty_dir(tmp_path, capsys):
     rc = main(["obs", "summarize", str(tmp_path)])
     assert rc == 1
     assert "empty run dir" in capsys.readouterr().err
+
+
+# -- phase-budget SLO rules --------------------------------------------------
+
+
+_PHASE_RULE = {
+    "name": "request-p95", "kind": "phase_budget",
+    "metric": "serve_latency_p95_s", "max": 1.0,
+    "phases": {
+        "prefill": {"metric": "serve_phase_prefill_p95_s", "budget": 0.2},
+        "decode": {"metric": "serve_phase_decode_p95_s", "budget": 0.7},
+    },
+}
+
+
+def test_phase_budget_attributes_breach_to_worst_phase():
+    r = Rule(dict(_PHASE_RULE))
+    # Within SLO: phases are remembered, nothing fires.
+    assert r.observe({"serve_latency_p95_s": 0.9,
+                      "serve_phase_prefill_p95_s": 0.1,
+                      "serve_phase_decode_p95_s": 0.6}) is None
+    alert = r.observe({"serve_latency_p95_s": 1.4,
+                       "serve_phase_prefill_p95_s": 0.1,
+                       "serve_phase_decode_p95_s": 1.2})
+    assert alert is not None and alert["kind"] == "phase_budget"
+    assert alert["phase"] == "decode"        # 1.2/0.7 beats 0.1/0.2
+    assert "decode" in alert["detail"]
+    assert alert["value"] == pytest.approx(1.4)
+    assert alert["limit"] == 1.0
+
+
+def test_phase_budget_attribution_survives_split_records():
+    # Total and phase metrics arrive in SEPARATE records (snapshot
+    # streams interleave); the last phase observation still attributes.
+    r = Rule(dict(_PHASE_RULE))
+    assert r.observe({"serve_phase_prefill_p95_s": 0.5}) is None
+    alert = r.observe({"serve_latency_p95_s": 2.0})
+    assert alert is not None and alert["phase"] == "prefill"
+
+
+def test_phase_budget_unattributed_when_phases_within_budget():
+    r = Rule(dict(_PHASE_RULE))
+    alert = r.observe({"serve_latency_p95_s": 1.5,
+                       "serve_phase_prefill_p95_s": 0.1,
+                       "serve_phase_decode_p95_s": 0.5})
+    assert alert is not None and alert["phase"] == "unattributed"
+    assert "within budget" in alert["detail"]
+
+
+def test_phase_budget_edge_triggered_like_threshold():
+    r = Rule(dict(_PHASE_RULE))
+    rec = {"serve_latency_p95_s": 2.0, "serve_phase_decode_p95_s": 1.5}
+    assert r.observe(rec) is not None       # ok -> breach fires
+    assert r.observe(rec) is None           # latched
+    assert r.observe({"serve_latency_p95_s": 0.5}) is None  # re-arms
+    assert r.observe(rec) is not None
+    assert r.fired == 2
+
+
+def test_phase_budget_spec_validation():
+    with pytest.raises(RuleError):   # needs max
+        Rule({"metric": "m", "kind": "phase_budget",
+              "phases": {"p": {"metric": "x", "budget": 1.0}}})
+    with pytest.raises(RuleError):   # needs non-empty phases
+        Rule({"metric": "m", "kind": "phase_budget", "max": 1.0})
+    with pytest.raises(RuleError):
+        Rule({"metric": "m", "kind": "phase_budget", "max": 1.0,
+              "phases": {}})
+    with pytest.raises(RuleError):   # phase needs a positive budget
+        Rule({"metric": "m", "kind": "phase_budget", "max": 1.0,
+              "phases": {"p": {"metric": "x", "budget": 0}}})
+    with pytest.raises(RuleError):   # bool budget is not a number here
+        Rule({"metric": "m", "kind": "phase_budget", "max": 1.0,
+              "phases": {"p": {"metric": "x", "budget": True}}})
+    with pytest.raises(RuleError):   # phase needs a metric string
+        Rule({"metric": "m", "kind": "phase_budget", "max": 1.0,
+              "phases": {"p": {"budget": 1.0}}})
+
+
+# -- histogram snapshot honesty fields (satellite) ---------------------------
+
+
+def test_histogram_snapshot_reports_window_and_retention():
+    reg = MetricsRegistry()
+    h = reg.histogram("h", max_samples=4)
+    for i in range(10):
+        h.observe(float(i), ts=100.0 + i)
+    snap = reg.snapshot()["h"]["series"][""]
+    assert snap["count"] == 10
+    assert snap["samples_retained"] == 4     # reservoir cap bites
+    assert snap["window_start_ts"] == 100.0
+    assert snap["window_end_ts"] == 109.0
+
+
+def test_histogram_snapshot_window_none_without_timestamps():
+    reg = MetricsRegistry()
+    h = reg.histogram("h")
+    h.observe(1.0)
+    h.observe(2.0)
+    snap = reg.snapshot()["h"]["series"][""]
+    assert snap["samples_retained"] == snap["count"] == 2
+    assert snap["window_start_ts"] is None
+    assert snap["window_end_ts"] is None
+
+
+# -- the fleet signal bus ----------------------------------------------------
+
+
+def test_rolling_window_prunes_to_record_time():
+    from deeplearning_cfn_tpu.obs.signals import RollingWindow
+
+    w = RollingWindow(window_s=10.0)
+    w.add(0.0, 1.0)
+    w.add(5.0, 2.0)
+    w.add(14.0, 3.0)              # cutoff 4.0: drops the t=0 sample
+    snap = w.snapshot()
+    assert snap["samples"] == 2
+    assert snap["window_start_ts"] == 5.0
+    assert snap["window_end_ts"] == 14.0
+    assert snap["last"] == 3.0
+    with pytest.raises(ValueError):
+        RollingWindow(window_s=0)
+
+
+def test_signal_bus_fleet_aggregate_and_replay_determinism():
+    from deeplearning_cfn_tpu.obs.signals import SignalBus
+
+    def _fold():
+        bus = SignalBus(names=["replica-0", "replica-1"])
+        bus.observe("replica-0", {"ts": 1.0, "serve_tokens_per_sec": 10.0,
+                                  "serve_queue_depth": 1,
+                                  "serve_latency_p95_s": 0.2})
+        bus.observe("replica-1", {"ts": 2.0, "serve_tokens_per_sec": 5.0,
+                                  "serve_queue_depth": 0,
+                                  "serve_latency_p95_s": 0.6})
+        bus.observe("replica-1", {"event": "alert", "rule": "lat"})
+        return bus.snapshot()
+
+    a, b = _fold(), _fold()
+    assert a == b                 # the bus never reads a clock
+    assert a["event"] == "signal_snapshot"
+    f = a["fleet"]
+    assert f["replicas"] == 2 and f["replicas_live"] == 2
+    assert f["tokens_per_sec"] == 15.0
+    assert f["queue_depth"] == 1
+    assert f["worst_latency_p95_s"] == 0.6
+    assert f["alerts"] == 1
+    assert a["replicas"]["replica-1"]["last_alert"] == "lat"
+    assert json.dumps(a)          # one JSONL line, the autoscaler wire
+
+
+def test_signal_bus_sequences_records_without_timestamps():
+    from deeplearning_cfn_tpu.obs.signals import SignalBus
+
+    bus = SignalBus()
+    bus.observe("r", {"serve_queue_depth": 3})      # no ts anywhere
+    win = bus.snapshot()["replicas"]["r"]["windowed"]["queue_depth"]
+    assert win["samples"] == 1
+    assert win["window_start_ts"] == 1.0            # seq counter stands in
+    assert win["last"] == 3
