@@ -208,7 +208,11 @@ def _finalize_green(record: dict, alive: bool, probe_note: str,
         for key in ("spec_gamma", "spec_accept_rate",
                     "tokens_per_target_step", "weight_bytes",
                     "e2e_latency_p50_s", "e2e_latency_p95_s",
-                    "goodput_tokens_per_sec", "wasted_tokens"):
+                    "goodput_tokens_per_sec", "wasted_tokens",
+                    "decode_p95_colocated", "decode_p95_disagg",
+                    "decode_p95_no_adversary",
+                    "handoff_latency_p50_s", "handoff_latency_p95_s",
+                    "handoff_bytes"):
             if key in record:
                 record[key] = None
     return record

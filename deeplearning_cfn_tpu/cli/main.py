@@ -357,7 +357,10 @@ def _cmd_bench(args) -> int:
                                policy=args.fleet_policy,
                                chaos_kill_step=args.fleet_chaos_step,
                                smoke=args.smoke,
-                               trace_dir=args.fleet_trace_dir)
+                               trace_dir=args.fleet_trace_dir,
+                               prefill_replicas=args.fleet_prefill,
+                               decode_replicas=args.fleet_decode,
+                               trace_mix=args.trace_mix)
         print(json.dumps(line))
         return 0
     if getattr(args, "obs_smoke", False):
@@ -629,10 +632,13 @@ def _fleet_read_trace(path: str, vocab: str):
     return trace, bpe
 
 
-def _fleet_build_replicas(args, n: int):
+def _fleet_build_replicas(args, n: int, specs=None, kv_block_size: int = 0):
     """N in-process engine replicas from the same checkpoint (fleet
     route / rollout). One load per replica — each engine owns its jit
-    closures — but the restored weights are identical by construction."""
+    closures — but the restored weights are identical by construction.
+    ``specs`` (a [(name, phase)] list) builds a disaggregated topology
+    instead of N co-located replicas; the phases require the paged path,
+    so pass ``kv_block_size`` with them."""
     from ..fleet import EngineReplica
     from ..serve.loader import load_engine
 
@@ -645,7 +651,9 @@ def _fleet_build_replicas(args, n: int):
         force_cpu_platform()
     replicas, at_step = [], None
     bpe = None
-    for i in range(n):
+    roles = specs if specs is not None \
+        else [(f"replica-{i}", "both") for i in range(n)]
+    for name, phase in roles:
         cfg = apply_overrides(get_preset(args.preset), args.overrides)
         if args.accelerator:
             cfg.stack.accelerator = args.accelerator
@@ -653,10 +661,12 @@ def _fleet_build_replicas(args, n: int):
             cfg, capacity=args.slots,
             default_max_new_tokens=args.max_new_tokens,
             decode_window=args.decode_window,
+            kv_block_size=kv_block_size,
             speculate_gamma=getattr(args, "speculate", 0),
             quantize=getattr(args, "quantize", ""),
+            phase=phase,
             vocab=args.vocab, allow_init=args.allow_init)
-        replicas.append(EngineReplica(f"replica-{i}", engine))
+        replicas.append(EngineReplica(name, engine))
     return replicas, bpe, at_step
 
 
@@ -696,14 +706,94 @@ def _fleet_print_results(router, rids, bpe):
         print(json.dumps(out), flush=True)
 
 
+def _fleet_up_disagg(args) -> int:
+    """--prefill/--decode: in-process phase-split fleet behind the
+    phase-aware router (the KV handoff is an in-memory block transfer,
+    so the phases share one process where the co-located default runs
+    one supervised child per replica). Writes the standard fleet
+    run-root layout — one role-named run dir per replica plus
+    router.jsonl — so `fleet status` and `obs summarize --fleet` read
+    the per-phase fleet like any other."""
+    from ..fleet import Router
+    from ..metrics.jsonl import MetricsWriter
+    from ..obs.report import render_fleet_report, summarize_fleet
+    from ..obs.sinks import JsonlSink
+
+    if args.prefill < 1 or args.decode < 1:
+        print("[dlcfn-tpu] a disaggregated fleet needs BOTH --prefill "
+              ">= 1 and --decode >= 1", file=sys.stderr)
+        return 2
+    cfg = apply_overrides(get_preset(args.preset), args.overrides)
+    if args.accelerator:
+        cfg.stack.accelerator = args.accelerator
+    run_root = args.run_root or os.path.join(
+        cfg.workdir, args.preset, "fleet")
+    os.makedirs(run_root, exist_ok=True)
+    specs = [(f"prefill-{i}", "prefill") for i in range(args.prefill)] \
+        + [(f"decode-{i}", "decode") for i in range(args.decode)]
+    try:
+        replicas, bpe, at_step = _fleet_build_replicas(
+            args, len(specs), specs=specs,
+            kv_block_size=args.kv_block_size)
+        trace, bpe2 = _fleet_read_trace(args.requests, args.vocab)
+    except (FileNotFoundError, ValueError, OSError) as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    bpe = bpe or bpe2
+    if at_step == -1:
+        print("[dlcfn-tpu] WARNING: fleet serving RANDOM weights "
+              "(--allow-init) — smoke mode only", file=sys.stderr)
+    router = Router(replicas, policy=args.policy)
+    writers = []
+    router_writer = MetricsWriter(os.path.join(run_root, "router.jsonl"),
+                                  also_stdout=False, all_processes=True)
+    writers.append(router_writer)
+    router.trace_sink = JsonlSink(router_writer)
+    rep_writers = {}
+    for rep in replicas:
+        os.makedirs(os.path.join(run_root, rep.id), exist_ok=True)
+        w = MetricsWriter(os.path.join(run_root, rep.id, "metrics.jsonl"),
+                          also_stdout=False, all_processes=True)
+        writers.append(w)
+        rep_writers[rep.id] = w
+        rep.trace_sink = JsonlSink(w)
+    print(f"[dlcfn-tpu] fleet up (disaggregated): {args.prefill} "
+          f"prefill + {args.decode} decode replica(s), "
+          f"{len(trace)} request(s), run root {run_root}",
+          file=sys.stderr)
+    rids = _fleet_route_trace(router, trace, args)
+    router.run_until_drained()
+    _fleet_print_results(router, rids, bpe)
+    stats = router.stats()
+    for rep in replicas:
+        rep.engine.metrics.emit(rep_writers[rep.id], replica=rep.id,
+                                phase=rep.phase)
+        rep.trace_sink = None
+    router.trace_sink = None
+    for w in writers:
+        w.close()
+    print(f"[dlcfn-tpu] fleet drained: {len(rids)} request(s), "
+          f"{stats['handoffs']} handoff(s) "
+          f"({stats['handoff_bytes']} bytes on the wire), "
+          f"dropped {stats['dropped_requests']}", file=sys.stderr)
+    try:
+        print(render_fleet_report(summarize_fleet(run_root)))
+    except FileNotFoundError:
+        pass
+    return 0 if stats["dropped_requests"] == 0 else 1
+
+
 def _cmd_fleet_up(args) -> int:
     """Run N serve child processes over a sharded request trace, each in
     its own run dir under --run-root, supervised with hang-vs-crash
     classification and bounded restart; prints the fleet report when
-    every replica drains."""
+    every replica drains. --prefill/--decode switches to the
+    disaggregated in-process topology instead."""
     from ..fleet import ReplicaProcSpec, ReplicaSupervisor
     from ..obs.report import render_fleet_report, summarize_fleet
 
+    if getattr(args, "prefill", 0) or getattr(args, "decode", 0):
+        return _fleet_up_disagg(args)
     cfg = apply_overrides(get_preset(args.preset), args.overrides)
     if args.accelerator:
         cfg.stack.accelerator = args.accelerator
@@ -1525,8 +1615,24 @@ def build_parser() -> argparse.ArgumentParser:
         "up",
         help="one command → serving fleet: N supervised serve child "
              "processes, the trace round-robin sharded across them, each "
-             "replica writing metrics/launch streams to its own run dir")
+             "replica writing metrics/launch streams to its own run dir; "
+             "--prefill/--decode instead builds a disaggregated "
+             "phase-split fleet (in-process, KV handoff between phases)")
     _add_fleet_engine_flags(flup)
+    flup.add_argument("--prefill", type=int, default=0,
+                      help="disaggregated topology: prefill replica "
+                           "count (pair with --decode; replaces the "
+                           "co-located --replicas processes with an "
+                           "in-process phase-split fleet)")
+    flup.add_argument("--decode", type=int, default=0,
+                      help="disaggregated topology: decode replica count "
+                           "(pair with --prefill)")
+    flup.add_argument("--kv-block-size", type=int, default=16,
+                      help="disaggregated topology: paged KV block size "
+                           "(the handoff artifact is block-structured)")
+    flup.add_argument("--policy", default="least_loaded",
+                      choices=["least_loaded", "round_robin"],
+                      help="disaggregated topology: routing policy")
     flup.add_argument("--run-root", default="",
                       help="fleet run root; per-replica run dirs are "
                            "created under it (default: <workdir>/<preset>"
@@ -1670,6 +1776,21 @@ def build_parser() -> argparse.ArgumentParser:
                          "zero-drop contract (dropped_requests)")
     be.add_argument("--fleet-replicas", type=int, default=2,
                     help="fleet scenario: replica count (default 2)")
+    be.add_argument("--fleet-prefill", type=int, default=0,
+                    help="fleet scenario: disaggregated topology — "
+                         "prefill replica count (pair with "
+                         "--fleet-decode; overrides --fleet-replicas and "
+                         "arms the co-located contract run)")
+    be.add_argument("--fleet-decode", type=int, default=0,
+                    help="fleet scenario: disaggregated topology — "
+                         "decode replica count (pair with "
+                         "--fleet-prefill)")
+    be.add_argument("--trace-mix", default="uniform",
+                    choices=["uniform", "prefill-heavy"],
+                    help="fleet scenario: arrival mix — 'prefill-heavy' "
+                         "interleaves long-prompt/short-decode "
+                         "adversaries with short-prompt latency streams "
+                         "(the decode-interference trace)")
     be.add_argument("--fleet-policy", default="least_loaded",
                     choices=["least_loaded", "round_robin"],
                     help="fleet scenario: routing policy")

@@ -39,26 +39,50 @@ METRIC = "fleet_tiny_nmt_tokens_per_sec"
 UNIT = "tokens/sec"
 
 
-def _single_engine_tokens(model, variables, trace: List[List[int]],
-                          slots: int, src_len: int, max_new_tokens: int,
-                          decode_window: int) -> List[List[int]]:
-    """The baseline: the same trace through ONE engine; returns the
-    per-trace-index token lists the fleet output must match."""
+def _single_engine_tokens(model, variables, pairs, slots: int,
+                          src_len: int, max_new_tokens: int,
+                          decode_window: int,
+                          kv_block_size: int = 0) -> List[List[int]]:
+    """The baseline: the same (src, budget) trace through ONE engine;
+    returns the per-trace-index token lists the fleet output must
+    match. ``kv_block_size > 0`` runs the paged path (the disagg
+    topologies are paged, so their baseline is too)."""
     engine = Engine(model, variables, capacity=slots, max_src_len=src_len,
-                    queue_depth=len(trace) + 1,
+                    queue_depth=len(pairs) + 1,
                     default_max_new_tokens=max_new_tokens,
-                    decode_window=decode_window)
+                    decode_window=decode_window,
+                    kv_block_size=kv_block_size)
     ids = []
-    for src in trace:
+    for src, budget in pairs:
         while True:
             try:
                 ids.append(engine.submit(
-                    src, max_new_tokens=max_new_tokens).id)
+                    src, max_new_tokens=budget).id)
                 break
             except OverloadError:
                 engine.step()
     engine.run_until_drained()
     return [list(engine.poll(i).tokens) for i in ids]
+
+
+def _prefill_heavy_trace(num_requests: int, src_len: int, vocab: int,
+                         max_new_tokens: int, seed: int):
+    """The adversarial mix: even arrivals are long-prompt/short-decode
+    requests (maximum admission-prefill work per token of output), odd
+    arrivals are short-prompt latency streams decoding to full budget.
+    On a co-located fleet the long prompts stall the latency streams'
+    decode; a disaggregated fleet absorbs them on the prefill pool."""
+    rng = np.random.default_rng(seed)
+    short_len = max(2, src_len // 3)
+    pairs = []
+    for i in range(num_requests):
+        if i % 2 == 0:
+            n, budget = src_len, min(2, max_new_tokens)   # the adversary
+        else:
+            n, budget = short_len, max_new_tokens         # latency stream
+        pairs.append(([int(t) for t in rng.integers(3, vocab, size=n)],
+                      budget))
+    return pairs
 
 
 def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
@@ -68,12 +92,34 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
                     policy: str = "least_loaded",
                     chaos_kill_step: int = 0,
                     smoke: bool = False,
-                    trace_dir: Optional[str] = None) -> Dict:
-    """Route the fixed trace across ``replicas`` engines to drain;
-    return the BENCH-contract record with the fleet fields. ``smoke``
-    shrinks the scenario AND runs the single-engine parity baseline
-    (the t1.sh gate asserts ``token_identical`` and
-    ``dropped_requests == 0``).
+                    trace_dir: Optional[str] = None,
+                    prefill_replicas: int = 0,
+                    decode_replicas: int = 0,
+                    trace_mix: str = "uniform",
+                    trace: Optional[List[List[int]]] = None) -> Dict:
+    """Route the fixed trace across the fleet to drain; return the
+    BENCH-contract record with the fleet fields. ``smoke`` shrinks the
+    scenario AND runs the single-engine parity baseline (the t1.sh gate
+    asserts ``token_identical`` and ``dropped_requests == 0``).
+
+    ``prefill_replicas``/``decode_replicas`` (both > 0) build a
+    DISAGGREGATED topology instead of ``replicas`` co-located engines:
+    prefill engines park each finished admission prefill and the router
+    hops the stream's KV blocks to a decode engine through the handoff
+    codec. The record then carries the contract run — the SAME trace
+    through a co-located paged fleet in the same invocation — yielding
+    ``token_identical_colocated`` plus ``decode_p95_disagg`` vs
+    ``decode_p95_colocated`` (measured over the latency streams when
+    ``trace_mix='prefill-heavy'``).
+
+    ``trace_mix='prefill-heavy'`` interleaves long-prompt/short-decode
+    adversaries with short-prompt latency streams: on a co-located
+    fleet the adversaries' admission prefill stalls the streams' decode
+    (the interference baseline); a disaggregated fleet absorbs them on
+    the prefill pool.
+
+    ``trace`` overrides the generated prompts (one src-id list per
+    request, each decoded to the full budget).
 
     ``trace_dir`` arms fleet tracing: each replica writes its span shard
     to ``<dir>/<replica>/metrics.jsonl``, the router writes its
@@ -86,8 +132,17 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
 
     if replicas < 1:
         raise ValueError(f"replicas must be >= 1, got {replicas}")
+    if (prefill_replicas > 0) != (decode_replicas > 0):
+        raise ValueError(
+            "disaggregation needs BOTH prefill and decode replicas (got "
+            f"prefill={prefill_replicas}, decode={decode_replicas})")
+    if trace_mix not in ("uniform", "prefill-heavy"):
+        raise ValueError(f"unknown trace mix {trace_mix!r}")
+    disagg = prefill_replicas > 0
     if smoke:
-        replicas = max(2, min(replicas, 2))
+        replicas = 2
+        if disagg:
+            prefill_replicas = decode_replicas = 1
         num_requests, slots = min(num_requests, 6), min(slots, 2)
         max_new_tokens, src_len = min(max_new_tokens, 4), min(src_len, 8)
 
@@ -97,31 +152,94 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         np.zeros((1, src_len), np.int32), np.ones((1, src_len), np.int32),
         np.zeros((1, src_len), np.int32), train=False)
     variables = {"params": init["params"]}
-    trace = _fixed_trace(num_requests, src_len, 96, seed=seed)
+    if trace is not None:
+        pairs = [([int(t) for t in src], max_new_tokens) for src in trace]
+        num_requests = len(pairs)
+    elif trace_mix == "prefill-heavy":
+        pairs = _prefill_heavy_trace(num_requests, src_len, 96,
+                                     max_new_tokens, seed)
+    else:
+        pairs = [(src, max_new_tokens)
+                 for src in _fixed_trace(num_requests, src_len, 96,
+                                         seed=seed)]
+
+    # Disaggregation rides the paged KV path (the handoff artifact is
+    # block-structured); the co-located contract fleet and the parity
+    # baseline use the same block size so the comparison is
+    # apples-to-apples.
+    kv_block_size = 4 if disagg else 0
 
     fault_plan = None
     if chaos_kill_step > 0:
         # chaos_kill_step is 1-based ("kill on the Nth router step of
-        # replica-0"); FaultSpec.at_calls counts per-site calls from 0.
+        # the first replica"); FaultSpec.at_calls counts from 0.
         fault_plan = FaultPlan([FaultSpec(
-            op="step", key="replica-0", kind="crash",
-            at_calls=(chaos_kill_step - 1,))])
+            op="step", key="prefill-0" if disagg else "replica-0",
+            kind="crash", at_calls=(chaos_kill_step - 1,))])
 
-    members: List[EngineReplica] = []
-    warmup_tokens: Dict[str, int] = {}
-    for i in range(replicas):
-        engine = Engine(model, variables, capacity=slots,
-                        max_src_len=src_len,
-                        queue_depth=max(num_requests, 4),
-                        default_max_new_tokens=max_new_tokens,
-                        decode_window=decode_window)
-        rep = EngineReplica(f"replica-{i}", engine, fault_plan=fault_plan)
-        # Warmup per replica, outside the timed window (each engine owns
-        # its own jit closures, so each compiles independently).
-        engine.submit(trace[0], max_new_tokens=min(2, max_new_tokens))
-        engine.run_until_drained()
-        warmup_tokens[rep.id] = engine.metrics.tokens_generated
-        members.append(rep)
+    def _build_fleet(specs, plan):
+        built: List[EngineReplica] = []
+        warm: Dict[str, int] = {}
+        for name, phase in specs:
+            engine = Engine(model, variables, capacity=slots,
+                            max_src_len=src_len,
+                            queue_depth=max(num_requests, 4),
+                            default_max_new_tokens=max_new_tokens,
+                            decode_window=decode_window,
+                            kv_block_size=kv_block_size,
+                            phase=phase)
+            rep = EngineReplica(name, engine, fault_plan=plan)
+            # Warmup per replica, outside the timed window (each engine
+            # owns its own jit closures, so each compiles
+            # independently). Full budget, so every fused-window shape
+            # the timed run decodes through is compiled up front — a
+            # decode replica otherwise pays window compiles inside the
+            # first stream's decode_s and poisons the p95 contract.
+            warm_req = engine.submit(
+                pairs[0][0], max_new_tokens=max_new_tokens)
+            engine.run_until_drained()
+            if phase == "prefill" and engine.handoff_ready(warm_req.id):
+                # Prefill engines park instead of finishing — free the
+                # warmup stream's blocks before traffic arrives.
+                engine.release_handoff(warm_req.id)
+            warm[rep.id] = engine.metrics.tokens_generated
+            built.append(rep)
+        return built, warm
+
+    def _drive(rt, drive_pairs, rid_prefix=None):
+        out = []
+        for i, (src, budget) in enumerate(drive_pairs):
+            rid = None if rid_prefix is None else f"{rid_prefix}{i}"
+            while True:
+                try:
+                    out.append(rt.submit(src, max_new_tokens=budget,
+                                         request_id=rid))
+                    break
+                except OverloadError:
+                    rt.step()   # fleet backpressure: drain, then retry
+        return out, rt.run_until_drained()
+
+    def _decode_p95(rt, rt_rids, rt_pairs):
+        """Decode-phase p95 from the router ledger; under the
+        adversarial mix, measured over the latency streams only (the
+        adversaries' two-token decode is trivially short either way)."""
+        vals = []
+        for rid, (_, budget) in zip(rt_rids, rt_pairs):
+            if trace_mix == "prefill-heavy" and budget != max_new_tokens:
+                continue
+            entry = rt.ledger.get(rid)
+            d = None if entry is None else entry["phases"].get("decode_s")
+            if d is not None:
+                vals.append(d)
+        return percentile(vals, 95)
+
+    if disagg:
+        specs = [(f"prefill-{i}", "prefill")
+                 for i in range(prefill_replicas)] \
+            + [(f"decode-{i}", "decode") for i in range(decode_replicas)]
+    else:
+        specs = [(f"replica-{i}", "both") for i in range(replicas)]
+    members, warmup_tokens = _build_fleet(specs, fault_plan)
     router = Router(members, policy=policy)
 
     writers = []
@@ -146,16 +264,7 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
             rep.trace_sink = JsonlSink(w)
 
     t0 = time.monotonic()
-    rids = []
-    for src in trace:
-        while True:
-            try:
-                rids.append(router.submit(
-                    src, max_new_tokens=max_new_tokens))
-                break
-            except OverloadError:
-                router.step()   # fleet backpressure: drain, then retry
-    ticks = router.run_until_drained()
+    rids, ticks = _drive(router, pairs)
     elapsed = time.monotonic() - t0
 
     results = [router.result(rid) for rid in rids]
@@ -172,6 +281,7 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         total_tokens += toks
         per_replica.append({
             "replica": rep.id,
+            "phase": rep.phase,
             "state": rep.state.value,
             "routed": router.routed.get(rep.id, 0),
             "tokens": toks,
@@ -196,7 +306,8 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
 
         bus = SignalBus(names=[rep.id for rep in members])
         for rep in members:
-            rep.engine.metrics.emit(rep_writers[rep.id], replica=rep.id)
+            rep.engine.metrics.emit(rep_writers[rep.id], replica=rep.id,
+                                    phase=rep.phase)
             bus.observe(rep.id, rep.engine.metrics.snapshot())
         signals_writer = MetricsWriter(
             os.path.join(trace_dir, "signals.jsonl"),
@@ -212,12 +323,12 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
     token_identical = None
     if smoke:
         baseline = _single_engine_tokens(
-            model, variables, trace, slots, src_len, max_new_tokens,
-            decode_window)
+            model, variables, pairs, slots, src_len, max_new_tokens,
+            decode_window, kv_block_size=kv_block_size)
         fleet_tokens = [r["tokens"] for r in results]
         token_identical = fleet_tokens == baseline
 
-    return {
+    record = {
         "metric": METRIC,
         "value": round(total_tokens / elapsed, 2) if elapsed > 0 else None,
         "unit": UNIT,
@@ -248,4 +359,51 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         "per_replica": per_replica,
         "smoke": smoke,
         "device": jax.default_backend(),
+        "prefill_replicas": prefill_replicas,
+        "decode_replicas": decode_replicas,
+        "trace_mix": trace_mix,
     }
+
+    if disagg:
+        # The contract run: the SAME trace through a co-located paged
+        # fleet of the same size, in the same invocation. Token parity
+        # proves the handoff changes nothing; the decode-p95 pair
+        # quantifies what disaggregation removes (prefill-induced
+        # decode stall — visible under the prefill-heavy mix).
+        co_specs = [(f"colocated-{i}", "both")
+                    for i in range(prefill_replicas + decode_replicas)]
+        co_members, _ = _build_fleet(co_specs, None)
+        co_router = Router(co_members, policy=policy)
+        co_rids, _ = _drive(co_router, pairs)
+        co_results = [co_router.result(rid) for rid in co_rids]
+        record["token_identical_colocated"] = (
+            [r["tokens"] for r in results]
+            == [r["tokens"] for r in co_results])
+        record["decode_p95_disagg"] = _decode_p95(router, rids, pairs)
+        record["decode_p95_colocated"] = _decode_p95(co_router, co_rids,
+                                                     pairs)
+        if trace_mix == "prefill-heavy":
+            # The no-adversary baseline: the SAME warmed disagg fleet,
+            # fresh router, latency streams only. "Flat vs this number"
+            # is the in-process form of the contract — one process
+            # steps every phase in turn, so wall-clock decode_s charges
+            # each stream for the whole tick and the co-located
+            # comparison understates what separate hosts would show.
+            streams = [p for p in pairs if p[1] == max_new_tokens]
+            base_router = Router(members, policy=policy)
+            base_rids, _ = _drive(base_router, streams,
+                                  rid_prefix="noadv-")
+            for rid in base_rids:
+                base_router.result(rid)
+            record["decode_p95_no_adversary"] = _decode_p95(
+                base_router, base_rids, streams)
+        record["handoffs"] = router.handoffs
+        record["handoff_latency_p50_s"] = percentile(
+            router.handoff_latencies, 50)
+        record["handoff_latency_p95_s"] = percentile(
+            router.handoff_latencies, 95)
+        record["handoff_bytes"] = (
+            round(router.handoff_bytes_total / router.handoffs)
+            if router.handoffs else None)
+
+    return record

@@ -75,6 +75,9 @@ class EngineReplica:
         self.fault_plan = fault_plan
         self.crashed = False
         self.steps = 0
+        # Disaggregated phase role, read off the engine ("both" for
+        # engines — and test fakes — that predate the phase split).
+        self.phase = getattr(engine, "phase", "both")
         # Per-replica trace shard. The engine emits spans through the
         # process-global tracer; attaching this sink only for the
         # duration of THIS replica's step keeps its spans out of the
@@ -109,7 +112,12 @@ class EngineReplica:
 
     @property
     def busy(self) -> bool:
-        return self.engine.queue.depth > 0 or self.engine.active_requests > 0
+        # Parked handoffs count: a prefill replica still holds rows and
+        # KV blocks for them, so drain (rollout) must wait until the
+        # router moves them to a decode replica.
+        return self.engine.queue.depth > 0 \
+            or self.engine.active_requests > 0 \
+            or getattr(self.engine, "handoff_pending", 0) > 0
 
     def submit(self, src_ids, **kwargs):
         if self.crashed:
@@ -151,6 +159,35 @@ class EngineReplica:
         self.steps += 1
         return n
 
+    # -- KV handoff (disaggregated prefill/decode) ---------------------------
+
+    def handoff_ready(self, request_id: str) -> bool:
+        if self.crashed:
+            return False
+        return bool(getattr(self.engine, "handoff_ready",
+                            lambda _rid: False)(request_id))
+
+    def export_handoff(self, request_id: str):
+        if self.crashed:
+            raise ReplicaCrashed(f"replica {self.id} is down")
+        return self.engine.export_handoff(request_id)
+
+    def import_handoff(self, artifact, request_id: str, trace_id=None):
+        if self.crashed:
+            raise ReplicaCrashed(f"replica {self.id} is down")
+        return self.engine.import_handoff(artifact, request_id,
+                                          trace_id=trace_id)
+
+    def release_handoff(self, request_id: str) -> None:
+        """Free the parked prefill state after a successful import. Runs
+        under this replica's trace sink: the release emits the
+        prefill-side ``serve.request`` span, which must land in THIS
+        shard for the cross-process flow link to pair up."""
+        if self.crashed:
+            raise ReplicaCrashed(f"replica {self.id} is down")
+        with self._traced():
+            self.engine.release_handoff(request_id)
+
     def record_evacuation(self, req, now: float) -> None:
         """Write the abandoned attempt into THIS replica's trace shard.
 
@@ -183,8 +220,10 @@ class EngineReplica:
         return {
             "replica": self.id,
             "state": self.state.value,
+            "phase": self.phase,
             "queue_depth": self.engine.queue.depth,
             "active_requests": self.engine.active_requests,
+            "handoff_pending": getattr(self.engine, "handoff_pending", 0),
             "capacity": self.engine.capacity,
             "step_latency_p50_s": percentile(m.step_latency_s, 50),
             "tokens_generated": m.tokens_generated,
@@ -200,13 +239,20 @@ class EngineReplica:
               max_steps: int = 256) -> bool:
         """Post-swap health check: run one tiny request to completion on
         THIS replica only (it is out of rotation, so the probe can't
-        collide with routed traffic). True iff it finishes DONE."""
+        collide with routed traffic). True iff it finishes DONE — or,
+        on a prefill-phase replica, iff it parks PREFILLED (that IS the
+        completed lifecycle there; the probe releases the parked state
+        so the replica comes back idle)."""
         if self.crashed or self.busy:
             return False
         try:
             req = self.engine.submit(list(src_ids),
                                      max_new_tokens=max_new_tokens)
             self.engine.run_until_drained(max_steps=max_steps)
+            if getattr(self.engine, "phase", "both") == "prefill" \
+                    and self.engine.handoff_ready(req.id):
+                self.engine.release_handoff(req.id)
+                return True
         except Exception:
             return False
         return req.state.value == "done"

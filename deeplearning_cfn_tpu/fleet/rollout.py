@@ -43,6 +43,7 @@ class ReplicaRolloutResult:
     probe_ok: bool
     readmitted: bool
     skipped: str = ""        # non-empty = why the replica was skipped
+    phase: str = "both"      # prefill / decode / both (disaggregation)
 
 
 @dataclasses.dataclass
@@ -82,13 +83,27 @@ def rolling_upgrade(router: Router, variables,
     submitting between replicas (the end-to-end test does exactly that).
     """
     results: List[ReplicaRolloutResult] = []
-    for rep_id in (order if order is not None else router.replica_ids()):
+    if order is None:
+        order = router.replica_ids()
+        if getattr(router, "disaggregated", False):
+            # Phase-aware order: decode replicas first, so the fleet's
+            # decode path is probed under the new weights before any
+            # prefill replica starts producing new-weight KV artifacts.
+            # While a decode replica is out of rotation, prefill
+            # replicas simply park finished streams — the router's
+            # handoff retry loop delivers them once it is readmitted.
+            order = sorted(order, key=lambda rid: (
+                0 if getattr(router.replica(rid), "phase", "both")
+                == "decode" else 1, rid))
+    for rep_id in order:
         r = router.replica(rep_id)
+        phase = getattr(r, "phase", "both")
         if r.crashed or r.state.value in ("down", "broken"):
             results.append(ReplicaRolloutResult(
                 replica=rep_id, drained=False, drain_steps=0,
                 evacuated=False, swapped=False, probe_ok=False,
-                readmitted=False, skipped=f"state={r.state.value}"))
+                readmitted=False, skipped=f"state={r.state.value}",
+                phase=phase))
             continue
         router.drain(rep_id)
         drain_steps = 0
@@ -112,7 +127,8 @@ def rolling_upgrade(router: Router, variables,
             results.append(ReplicaRolloutResult(
                 replica=rep_id, drained=False, drain_steps=drain_steps,
                 evacuated=True, swapped=False, probe_ok=False,
-                readmitted=False, skipped="crashed during drain"))
+                readmitted=False, skipped="crashed during drain",
+                phase=phase))
             continue
         drained = not r.busy
         swapped = False
@@ -131,7 +147,7 @@ def rolling_upgrade(router: Router, variables,
         results.append(ReplicaRolloutResult(
             replica=rep_id, drained=drained, drain_steps=drain_steps,
             evacuated=evacuated, swapped=swapped, probe_ok=probe_ok,
-            readmitted=readmitted))
+            readmitted=readmitted, phase=phase))
     return RolloutReport(results=results)
 
 

@@ -36,6 +36,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..obs.trace import get_tracer, obs_enabled
+from ..serve.handoff import drop_handoff, load_handoff, save_handoff
 from ..serve.queue import OverloadError
 from .replica import EngineReplica, ReplicaCrashed, ReplicaState
 
@@ -155,6 +156,14 @@ class _LogicalRequest:
         self.wasted_tokens = 0      # decoded on attempts we abandoned
         self.hops: List[str] = []   # every replica that held a copy
         self.finalized = False
+        # -- disaggregated prefill/decode hop -------------------------
+        # Set when the router moved this stream from a prefill replica
+        # to a decode replica. ``phase_prefix`` preserves the prefill
+        # side's queue_wait/prefill split (the decode-side Request was
+        # born admitted, so its own timestamps can't reconstruct them).
+        self.phase_prefix: Optional[Dict] = None
+        self.handoff_s: Optional[float] = None
+        self.handoff_bytes: Optional[int] = None
 
 
 class Router:
@@ -169,7 +178,7 @@ class Router:
 
     def __init__(self, replicas: List[EngineReplica],
                  policy="least_loaded", breaker_threshold: int = 3,
-                 clock=time.monotonic):
+                 clock=time.monotonic, handoff_store=None):
         if breaker_threshold < 1:
             raise ValueError(
                 f"breaker_threshold must be >= 1, got {breaker_threshold}")
@@ -206,6 +215,28 @@ class Router:
         self.goodput_tokens = 0
         self.wasted_tokens = 0
         self.ledger: Dict[str, Dict] = {}
+        # Disaggregated serving: transport for KV-handoff artifacts
+        # (lazily a MemoryObjectStore — in-process fleets hand blocks
+        # over through memory; cross-host fleets pass a PosixStore).
+        self._handoff_store = handoff_store
+        self.handoffs = 0
+        self.handoff_bytes_total = 0
+        self.handoff_latencies: List[float] = []
+
+    @property
+    def handoff_store(self):
+        if self._handoff_store is None:
+            from ..ckpt.store import MemoryObjectStore
+            self._handoff_store = MemoryObjectStore()
+        return self._handoff_store
+
+    @property
+    def disaggregated(self) -> bool:
+        """True when any replica is phase-restricted — placement then
+        targets prefill replicas and finished prefills hop to decode
+        replicas each tick."""
+        return any(getattr(r, "phase", "both") != "both"
+                   for r in self._replicas.values())
 
     # -- membership ---------------------------------------------------------
 
@@ -260,6 +291,12 @@ class Router:
 
     def _place(self, lr: _LogicalRequest) -> None:
         candidates = self._routable()
+        if self.disaggregated:
+            # New work always enters through prefill; decode-only
+            # replicas receive streams via KV handoff, never submits.
+            candidates = [r for r in candidates
+                          if getattr(r, "phase", "both")
+                          in ("both", "prefill")]
         if not candidates:
             raise NoReplicasError(
                 "no routable replicas (all down, broken, or draining)")
@@ -325,6 +362,10 @@ class Router:
                 self._failures[rep_id] = n
                 if n >= self.breaker_threshold:
                     self._open_breaker(r)
+        # Handoffs count as progress: a tick that only moved parked
+        # streams to decode replicas must not read as "wedged" to
+        # run_until_drained — the moved streams decode next tick.
+        total += self._process_handoffs()
         return total
 
     def _retry_backlog(self) -> None:
@@ -336,6 +377,98 @@ class Router:
             except (FleetOverloadError, NoReplicasError):
                 still.append(rid)
         self._backlog = still
+
+    # -- disaggregated prefill → decode handoff -----------------------------
+
+    def _process_handoffs(self) -> int:
+        """Move every stream parked on a prefill replica to a decode
+        replica. Returns the number of hops completed this tick. A
+        stream that finds no decode capacity stays parked (its KV blocks
+        remain live on the prefill side) and is retried next tick —
+        parked work is never dropped, mirroring the backlog contract."""
+        if not self.disaggregated:
+            return 0
+        hops = 0
+        for lr in list(self._requests.values()):
+            if lr.replica_id is None or lr.replica_rid is None:
+                continue
+            rep = self._replicas.get(lr.replica_id)
+            if rep is None or getattr(rep, "phase", "both") != "prefill":
+                continue
+            try:
+                if not rep.handoff_ready(lr.replica_rid):
+                    continue
+            except ReplicaCrashed:
+                self._mark_down(rep)
+                continue
+            hops += self._hand_off(lr, rep)
+        return hops
+
+    def _hand_off(self, lr: _LogicalRequest, rep: EngineReplica) -> int:
+        """One prefill→decode hop: export the parked stream's KV blocks
+        through the store codec, import on the best decode replica,
+        release the prefill side. Returns 1 on success, 0 when no decode
+        replica had capacity (the stream stays parked)."""
+        t0 = self._clock()
+        old_rid = lr.replica_rid
+        try:
+            prefill_req = rep.poll(old_rid)
+            artifact = rep.export_handoff(old_rid)
+        except ReplicaCrashed:
+            self._mark_down(rep)
+            return 0
+        # Round-trip through the store codec even for in-memory fleets:
+        # the decode side imports what crossed the wire, so codec bugs
+        # fail parity tests instead of hiding behind an object share.
+        store = self.handoff_store
+        key = f"handoff/{lr.rid}-a{lr.attempts}"
+        nbytes = save_handoff(store, key, artifact)
+        loaded = load_handoff(store, key)
+        candidates = [r for r in self._routable()
+                      if getattr(r, "phase", "both") in ("decode", "both")]
+        ordered = self.policy.order(
+            [(r.id, r.health()) for r in candidates])
+        for rep_id in ordered:
+            d = self._replicas[rep_id]
+            lr.attempts += 1
+            new_rid = f"{lr.rid}#a{lr.attempts}"
+            try:
+                d.import_handoff(loaded, request_id=new_rid,
+                                 trace_id=lr.rid)
+            except OverloadError:
+                continue
+            except ReplicaCrashed:
+                self._mark_down(d)
+                continue
+            # Preserve the prefill side's phase split before releasing
+            # it — the decode-side Request is born admitted, so its own
+            # timestamps say queue_wait=0, prefill=None.
+            t_sub, t_adm = (prefill_req.submitted_at,
+                            prefill_req.admitted_at)
+            lr.phase_prefix = {
+                "queue_wait_s": max(t_adm - t_sub, 0.0)
+                if t_adm is not None else None,
+                "prefill_s": prefill_req.prefill_s,
+            }
+            try:
+                rep.release_handoff(old_rid)
+            except ReplicaCrashed:
+                self._mark_down(rep)
+            lr.replica_id = rep_id
+            lr.replica_rid = new_rid
+            lr.hops.append(rep_id)
+            dt = max(self._clock() - t0, 0.0)
+            lr.handoff_s = (lr.handoff_s or 0.0) + dt
+            lr.handoff_bytes = nbytes
+            self.handoffs += 1
+            self.handoff_bytes_total += nbytes
+            self.handoff_latencies.append(dt)
+            self.policy.note_routed(rep_id)
+            self.routed[rep_id] = self.routed.get(rep_id, 0) + 1
+            drop_handoff(store, key)
+            return 1
+        drop_handoff(store, key)
+        return 0
 
     def _mark_down(self, r: EngineReplica) -> None:
         r.state = ReplicaState.DOWN
@@ -471,17 +604,29 @@ class Router:
             if t_sub is not None and t_adm is not None else None
         decode = max(t_fin - t_adm - (prefill or 0.0), 0.0) \
             if t_adm is not None and t_fin is not None else None
+        if lr.phase_prefix is not None:
+            # The stream hopped prefill→decode: the terminal Request is
+            # the decode-side copy (born admitted, no prefill of its
+            # own), so queue_wait/prefill come from the prefill side's
+            # snapshot and decode is the decode replica's dwell time.
+            queue_wait = lr.phase_prefix.get("queue_wait_s")
+            prefill = lr.phase_prefix.get("prefill_s")
         emit = max(now - t_fin, 0.0) if t_fin is not None else None
         e2e = max(now - lr.submitted_ts, 0.0) \
             if lr.submitted_ts is not None else None
+        phases = {"queue_wait_s": queue_wait, "prefill_s": prefill,
+                  "decode_s": decode, "stall_s": lr.stall_s,
+                  "emit_s": emit}
+        if lr.handoff_s is not None:
+            # Only hopped requests carry the extra phase — co-located
+            # ledger entries keep the exact five-phase shape.
+            phases["handoff_s"] = lr.handoff_s
         self.ledger[lr.rid] = {
             "request_id": lr.rid, "state": state,
             "attempts": lr.attempts, "replicas": list(lr.hops),
             "goodput_tokens": goodput, "wasted_tokens": lr.wasted_tokens,
             "e2e_s": e2e,
-            "phases": {"queue_wait_s": queue_wait, "prefill_s": prefill,
-                       "decode_s": decode, "stall_s": lr.stall_s,
-                       "emit_s": emit},
+            "phases": phases,
         }
         self._emit_request_span(lr, self.ledger[lr.rid])
 
@@ -536,10 +681,12 @@ class Router:
             h = r.health()
             per[rid] = {
                 "state": r.state.value,
+                "phase": getattr(r, "phase", "both"),
                 "routed": self.routed.get(rid, 0),
                 "tokens_generated": h["tokens_generated"],
                 "queue_depth": h["queue_depth"],
                 "active_requests": h["active_requests"],
+                "handoff_pending": h.get("handoff_pending", 0),
             }
         return {
             "replicas": per,
@@ -549,4 +696,6 @@ class Router:
             "dropped_requests": self.dropped_requests,
             "goodput_tokens": self.goodput_tokens,
             "wasted_tokens": self.wasted_tokens,
+            "handoffs": self.handoffs,
+            "handoff_bytes": self.handoff_bytes_total,
         }

@@ -156,6 +156,10 @@ def summarize(path: str,
         last = serve[-1]
         out["serve"] = {
             "records": len(serve),
+            # Disaggregated fleets tag each replica's emission with its
+            # phase role; co-located snapshots carry no tag.
+            "phase": last.get("phase"),
+            "queue_depth": last.get("serve_queue_depth"),
             "submitted": last.get("serve_submitted"),
             "admitted": last.get("serve_admitted"),
             "completed": last.get("serve_completed"),
@@ -360,6 +364,17 @@ def summarize_fleet(root: str) -> Dict[str, Any]:
         for rec in records:
             bus.observe(name, rec)
     agg = bus.fleet()
+    # Per-phase queue depth: a starved decode pool must be visible as
+    # its own number, not folded into the fleet aggregate. Co-located
+    # replicas (no phase tag) fold under "both".
+    queue_by_phase: Dict[str, int] = {}
+    for name, s in replicas.items():
+        sv = s.get("serve") or {}
+        phase = sv.get("phase") or "both"
+        qd = sv.get("queue_depth")
+        if isinstance(qd, (int, float)):
+            queue_by_phase[phase] = \
+                queue_by_phase.get(phase, 0) + int(qd)
     return {
         "source": {"path": root, "replicas": len(dirs),
                    "records": total_records},
@@ -375,6 +390,7 @@ def summarize_fleet(root: str) -> Dict[str, Any]:
             "launch_attempts": agg["launch_attempts"] or None,
             "launch_restarts": agg["launch_restarts"],
             "launch_failed_replicas": agg["launch_failed_replicas"],
+            "queue_depth_by_phase": queue_by_phase or None,
         },
         "signals": bus.snapshot(),
         "replicas": replicas,
@@ -404,6 +420,10 @@ def render_fleet_report(summary: Dict[str, Any]) -> str:
                   if f["launch_failed_replicas"] else "")
         L.append(f"  launch: {f['launch_attempts']} attempt(s), "
                  f"{f['launch_restarts']} restart(s){failed}")
+    qbp = f.get("queue_depth_by_phase")
+    if qbp and set(qbp) != {"both"}:
+        L.append("  queue depth by phase: " + "  ".join(
+            f"{phase}={qbp[phase]}" for phase in sorted(qbp)))
     for name, s in summary["replicas"].items():
         sv = s.get("serve") or {}
         la = s.get("launch") or {}
@@ -413,6 +433,9 @@ def render_fleet_report(summary: Dict[str, Any]) -> str:
                 f"done {_fmt(sv.get('completed'))}/"
                 f"{_fmt(sv.get('submitted'))}",
                 f"p95 {_fmt(lat.get('p95'), 's')}"]
+        if sv.get("phase"):
+            bits.insert(0, f"phase {sv['phase']} "
+                           f"(q {_fmt(sv.get('queue_depth'))})")
         if la:
             bits.append(
                 f"launch {','.join(str(o) for o in la['outcomes'])}")

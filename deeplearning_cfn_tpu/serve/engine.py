@@ -118,6 +118,11 @@ class _Group:
     # Paged mode: worst-case KV blocks reserved for this request at
     # admission (returned to the pool's commit ledger on release).
     committed_blocks: int = 0
+    # Disaggregated serving: decode steps this group arrived with via KV
+    # handoff (performed — and ledgered — on the prefill engine). Keeps
+    # the per-engine goodput invariant exact: this engine's goodput only
+    # counts tokens it decoded itself.
+    imported_tokens: int = 0
 
 
 class Engine:
@@ -147,6 +152,7 @@ class Engine:
                  draft_model=None,
                  draft_variables=None,
                  quantize: str = "",
+                 phase: str = "both",
                  clock=time.monotonic,
                  metrics: Optional[ServeMetrics] = None,
                  retry_after_floor_s: Optional[float]
@@ -159,6 +165,21 @@ class Engine:
         if speculate_gamma < 0:
             raise ValueError(
                 f"speculate_gamma must be >= 0, got {speculate_gamma}")
+        # Disaggregated serving phase. "both" (default) is the co-located
+        # engine, behavior-identical to before the split. "prefill" runs
+        # admission prefill + exactly ONE decode step per request, then
+        # parks it for KV handoff; "decode" additionally accepts imported
+        # handoff artifacts (import_handoff) and resumes them mid-stream.
+        self.phase = str(phase or "both")
+        if self.phase not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"phase must be 'both', 'prefill' or 'decode', got "
+                f"{phase!r}")
+        if self.phase != "both" and int(kv_block_size) <= 0:
+            raise ValueError(
+                "disaggregated phases require the paged KV path "
+                "(kv_block_size > 0) — the handoff artifact is "
+                "block-structured")
         # Int8 weight-only quantization happens HERE, not in the loader:
         # the engine owns the (model clone, quantized params) pairing, so
         # swap_variables can re-quantize an incoming fp32 checkpoint and
@@ -372,6 +393,14 @@ class Engine:
         self._pos = np.zeros((cap,), np.int32)
         self._row_owner: List[Optional[str]] = [None] * cap
         self._groups: List[_Group] = []
+        # Prefill phase: groups whose prefill step ran, parked with their
+        # rows and blocks still bound, awaiting export_handoff +
+        # release_handoff (or cancel/expiry via _reap_parked). Subsequent
+        # ticks' stray device writes land at/above a parked row's frozen
+        # position — harmless by write-before-attend — but the fused
+        # window DOES clobber the parked row's _prev host mirror with
+        # PAD, so export reconstructs prev from group state instead.
+        self._handoff_ready: Dict[str, _Group] = {}
 
         # Draft-side device state. The draft cache is always a dense
         # [capacity, H, max_len, D] row table (a shrunk draft is small —
@@ -463,10 +492,11 @@ class Engine:
         reason — its entries are old-weight encoder outputs. Compiled
         functions are keyed on shapes only, so the swap costs no
         recompilation."""
-        if self._groups or self.queue.depth > 0:
+        if self._groups or self.queue.depth > 0 or self._handoff_ready:
             raise RuntimeError(
                 f"swap_variables requires an idle engine "
-                f"({len(self._groups)} running, {self.queue.depth} queued) "
+                f"({len(self._groups)} running, {self.queue.depth} queued, "
+                f"{len(self._handoff_ready)} parked for handoff) "
                 f"— drain first")
         if self.quantize:
             # The engine serves a quantized model clone, so an incoming
@@ -485,6 +515,14 @@ class Engine:
     @property
     def active_requests(self) -> int:
         return len(self._groups)
+
+    @property
+    def handoff_pending(self) -> int:
+        """Requests parked on this (prefill) engine awaiting handoff."""
+        return len(self._handoff_ready)
+
+    def handoff_ready(self, request_id: str) -> bool:
+        return request_id in self._handoff_ready
 
     @property
     def active_rows(self) -> int:
@@ -568,8 +606,8 @@ class Engine:
             self._block_tables[r] = 0
             self._block_tables[r, :len(new_lists[j])] = new_lists[j]
 
-    def _release(self, group: _Group, state: RequestState,
-                 now: float) -> None:
+    def _free_group_resources(self, group: _Group) -> None:
+        """Return a group's rows + KV blocks to the scheduler/pool."""
         for r in group.rows:
             self._row_owner[r] = None
             self._prev[r] = PAD_ID
@@ -582,16 +620,26 @@ class Engine:
         if self.paged:
             self.allocator.uncommit(group.committed_blocks)
             group.committed_blocks = 0
+
+    def _release(self, group: _Group, state: RequestState,
+                 now: float) -> None:
+        self._free_group_resources(group)
         group.req.state = state
         group.req.finished_at = now
-        self._groups.remove(group)
+        if group in self._groups:
+            self._groups.remove(group)
+        else:
+            # Cancelled/expired while parked for handoff (_reap_parked).
+            self._handoff_ready.pop(group.req.id, None)
         self.metrics.record_finish(state.value, group.req.latency_s)
         # Goodput/waste ledger: every decoded row-step is attributed
         # exactly once. DONE keeps its response tokens as goodput (the
         # remainder is beam-discarded work); cancelled/expired decode
         # work reached no response and is all waste. The invariant
-        # goodput + wasted == tokens_generated holds per drained engine.
-        kept = len(group.req.tokens)
+        # goodput + wasted == tokens_generated holds per drained engine:
+        # tokens a handoff import arrived with were decoded — and
+        # ledgered — on the prefill engine, so they are subtracted here.
+        kept = max(0, len(group.req.tokens) - group.imported_tokens)
         if state is RequestState.DONE:
             self.metrics.record_ledger(
                 goodput=kept, wasted=max(0, group.decoded - kept),
@@ -631,6 +679,32 @@ class Engine:
                 if g.req.beam_size > 1:
                     self._finalize_beam(g)
                 self._release(g, RequestState.EXPIRED, now)
+
+    def _reap_parked(self, now: float) -> None:
+        """Cancel/expire requests parked for KV handoff: their rows and
+        blocks free exactly like a running group's (the router simply
+        finds handoff_ready False and the poll state terminal)."""
+        for g in list(self._handoff_ready.values()):
+            if g.req.cancel_requested:
+                if g.req.beam_size > 1:
+                    self._finalize_beam(g)
+                self._release(g, RequestState.CANCELLED, now)
+            elif g.req.deadline is not None and now >= g.req.deadline:
+                if g.req.beam_size > 1:
+                    self._finalize_beam(g)
+                self._release(g, RequestState.EXPIRED, now)
+
+    def _park_ready(self, now: float) -> None:
+        """Prefill phase: every group whose prefill decode step has run
+        leaves the tick loop and parks awaiting handoff. Rows and blocks
+        stay bound — the KV state IS the handoff payload — and the
+        request becomes pollable as PREFILLED (not finished: the stream
+        resumes on a decode replica as a new attempt)."""
+        for g in list(self._groups):
+            if g.steps >= 1:
+                self._groups.remove(g)
+                g.req.state = RequestState.PREFILLED
+                self._handoff_ready[g.req.id] = g
 
     def _admit(self, now: float) -> None:
         """Admit every queued request that fits, then prefill them all in
@@ -875,6 +949,11 @@ class Engine:
         itself lands at the window boundary)."""
         if self.decode_window <= 1:
             return 1
+        if self.phase == "prefill":
+            # Prefill runs exactly one decode step per request before
+            # parking it — a wider window would decode past the handoff
+            # point on the wrong replica.
+            return 1
         if any(g.req.beam_size > 1 for g in self._groups):
             return 1
         if any(g.req.deadline is not None for g in self._groups):
@@ -1045,6 +1124,7 @@ class Engine:
         single-step logits path so beam parity is untouched."""
         now = self._clock()
         self._reap(now)
+        self._reap_parked(now)
         with span("serve.admit", queued=self.queue.depth) as sp:
             before = len(self._groups)
             self._admit(now)
@@ -1059,23 +1139,30 @@ class Engine:
         if any(g.req.beam_size > 1 for g in self._groups):
             with span("serve.decode", path="host", k=1,
                       request_ids=active_ids):
-                return self._host_step()
+                n = self._host_step()
         # Speculate only when the tick is pure greedy with no deadlines:
         # beams need per-step host top-k (handled above), and a pending
         # deadline must be able to expire within one plain step — the
         # spec window advances up to γ+1 positions per call, which would
-        # defer expiry. Both fallbacks are per-tick, so a mixed trace
-        # flips between paths without any state migration (the spec step
-        # and the plain window share the same caches and positions).
-        if self.speculate_gamma > 0 and not any(
-                g.req.deadline is not None for g in self._groups):
+        # defer expiry. A prefill-phase engine never speculates either:
+        # it runs exactly one decode step before parking. Both fallbacks
+        # are per-tick, so a mixed trace flips between paths without any
+        # state migration (the spec step and the plain window share the
+        # same caches and positions).
+        elif self.speculate_gamma > 0 and self.phase != "prefill" \
+                and not any(g.req.deadline is not None
+                            for g in self._groups):
             with span("serve.decode", path="spec",
                       k=self.speculate_gamma, request_ids=active_ids):
-                return self._spec_step()
-        k = self._plan_window()
-        with span("serve.decode", path="fused", k=k,
-                  request_ids=active_ids):
-            return self._fused_step(k)
+                n = self._spec_step()
+        else:
+            k = self._plan_window()
+            with span("serve.decode", path="fused", k=k,
+                      request_ids=active_ids):
+                n = self._fused_step(k)
+        if self.phase == "prefill":
+            self._park_ready(self._clock())
+        return n
 
     def _fused_step(self, k: int) -> int:
         """Greedy fast path: K fused steps in one device call."""
@@ -1234,6 +1321,268 @@ class Engine:
             rows_active, self.queue.depth, new_tokens, self._clock() - t0,
             kv_blocks_in_use=kv_in_use)
         return 1
+
+    # -- KV handoff (disaggregated prefill/decode) -------------------------
+
+    def _pool_leaf_p(self, leaf) -> bool:
+        return getattr(leaf, "ndim", 0) == 4 and \
+            leaf.shape[0] == self.kv_blocks
+
+    def export_handoff(self, request_id: str) -> Dict[str, np.ndarray]:
+        """Serialize a parked request's resume state (see
+        serve/handoff.py for the artifact schema). Read-only: the group
+        stays parked and intact until :meth:`release_handoff`, so a
+        failed import on the decode side can simply retry."""
+        from .handoff import pack_meta
+
+        g = self._handoff_ready.get(request_id)
+        if g is None:
+            raise KeyError(
+                f"no parked handoff for request {request_id!r}")
+        rows = g.rows
+        w = len(rows)
+        # Unique exported blocks in first-appearance order; beam rows
+        # sharing prefix blocks reference the SAME artifact index, so the
+        # importer re-shares them by refcount instead of copying.
+        block_index: Dict[int, int] = {}
+        rbi = np.full((w, self.max_blocks_per_row), -1, np.int32)
+        for j, r in enumerate(rows):
+            for i, b in enumerate(self._blocks_bound[r]):
+                if b not in block_index:
+                    block_index[b] = len(block_index)
+                rbi[j, i] = block_index[b]
+        unique = np.asarray(list(block_index.keys()), np.int32)
+        artifact: Dict[str, np.ndarray] = {"row_block_index": rbi}
+        li = 0
+        for leaf in jax.tree_util.tree_leaves(self.cache):
+            if self._pool_leaf_p(leaf):
+                artifact[f"kv_{li}"] = np.asarray(leaf[unique])
+                li += 1
+        # The fused window clobbers parked rows' _prev mirror with PAD
+        # (inactive rows come back PAD from the scan), so prev is
+        # reconstructed from group state, never read from the mirror.
+        if w == 1:
+            prev = np.asarray([g.req.tokens[-1]], np.int32)
+        else:
+            prev = np.asarray(g.beam_tokens[:, g.steps], np.int32)
+        artifact.update({
+            "enc": np.asarray(self._enc[rows[0]]),
+            "src_mask": np.asarray(self._src_mask[rows[0]], np.int32),
+            "src_ids": np.asarray(g.req.src_ids, np.int32),
+            "tokens": np.asarray(g.req.tokens, np.int32),
+            "prev": prev,
+            "pos": np.asarray([self._pos[r] for r in rows], np.int32),
+            "meta": pack_meta(
+                version=1, width=w, steps=g.steps, budget=g.budget,
+                kv_block_size=self.kv_block_size,
+                model_max_len=self.model_max_len,
+                max_src_len=self.max_src_len, enc_hid=self._enc_hid),
+            "deadline": np.asarray(
+                [np.nan if g.req.deadline is None else g.req.deadline],
+                np.float64),
+        })
+        if w > 1:
+            artifact["scores"] = np.asarray(g.scores, np.float32)
+            artifact["beam_done"] = np.asarray(g.beam_done, bool)
+            artifact["beam_tokens"] = np.asarray(g.beam_tokens, np.int32)
+        return artifact
+
+    def import_handoff(self, artifact: Dict[str, np.ndarray],
+                       request_id: str,
+                       trace_id: Optional[str] = None) -> Request:
+        """Ingest a handoff artifact into this engine's own block pool
+        and resume decode mid-stream. Block ids are remapped through the
+        importer's free list (the artifact carries pool-independent
+        indices); rows, blocks and the worst-case commit are reserved
+        here exactly as a fresh admission would, so an import that does
+        not fit raises OverloadError and the exporter's parked state
+        stays untouched for a later retry."""
+        from .handoff import kv_leaf_count, validate_artifact
+
+        if self.phase == "prefill":
+            raise RuntimeError(
+                "a prefill-phase engine cannot import handoffs")
+        if not self.paged:
+            raise RuntimeError(
+                "import_handoff requires the paged KV path")
+        meta = validate_artifact(artifact)
+        for key, mine in (("kv_block_size", self.kv_block_size),
+                          ("model_max_len", self.model_max_len),
+                          ("max_src_len", self.max_src_len),
+                          ("enc_hid", self._enc_hid)):
+            if meta[key] != mine:
+                raise ValueError(
+                    f"handoff artifact {key}={meta[key]} does not match "
+                    f"this engine's {mine}")
+        w, steps, budget = meta["width"], meta["steps"], meta["budget"]
+        free = self._free_rows()
+        peak = self._peak_blocks(w, budget)
+        rbi = np.asarray(artifact["row_block_index"], np.int32)
+        n_unique = int(artifact["kv_0"].shape[0])
+        if w > len(free) or not self.allocator.can_commit(peak) \
+                or n_unique > self.allocator.free_blocks:
+            raise OverloadError(
+                self.queue.depth, self.queue.max_depth,
+                retry_after_s=self.queue.retry_after_floor_s)
+        now = self._clock()
+        deadline = float(artifact["deadline"][0])
+        req = Request(
+            id=request_id,
+            src_ids=[int(t) for t in artifact["src_ids"]],
+            max_new_tokens=budget, beam_size=w,
+            deadline=None if np.isnan(deadline) else deadline,
+            state=RequestState.RUNNING, submitted_at=now,
+            admitted_at=now,
+            tokens=[int(t) for t in artifact["tokens"]],
+            trace_id=trace_id)
+        self.queue.adopt(req)
+        self.metrics.record_submit()
+        self.metrics.record_admit(0.0)
+        self.allocator.commit(peak)
+        # Remap: one fresh block per unique exported block, drawn from
+        # THIS pool's free list (ids need not match the exporter's);
+        # every additional row referencing the same artifact index
+        # re-shares it via refcount.
+        new_ids = [self.allocator.alloc() for _ in range(n_unique)]
+        rows = free[:w]
+        prev = np.asarray(artifact["prev"], np.int32)
+        pos = np.asarray(artifact["pos"], np.int32)
+        refs = np.zeros((n_unique,), np.int64)
+        for j, r in enumerate(rows):
+            bound = []
+            for i in range(rbi.shape[1]):
+                idx = int(rbi[j, i])
+                if idx < 0:
+                    break
+                bound.append(new_ids[idx])
+                refs[idx] += 1
+            self._blocks_bound[r] = bound
+            self._block_tables[r] = 0
+            self._block_tables[r, :len(bound)] = bound
+            self._row_owner[r] = request_id
+            self._prev[r] = prev[j]
+            self._pos[r] = pos[j]
+        for idx in range(n_unique):
+            for _ in range(int(refs[idx]) - 1):
+                self.allocator.ref(new_ids[idx])
+        # Scatter the KV payload into this pool's leaves at the remapped
+        # ids (leaf order is deterministic — same model, same tree).
+        leaves, treedef = jax.tree_util.tree_flatten(self.cache)
+        ids_dev = jnp.asarray(np.asarray(new_ids, np.int32))
+        li = 0
+        out_leaves = []
+        for leaf in leaves:
+            if self._pool_leaf_p(leaf):
+                payload = jnp.asarray(artifact[f"kv_{li}"])
+                out_leaves.append(
+                    leaf.at[ids_dev].set(payload.astype(leaf.dtype)))
+                li += 1
+            else:
+                out_leaves.append(leaf)
+        if li != kv_leaf_count(artifact):
+            raise ValueError(
+                f"artifact carries {kv_leaf_count(artifact)} KV leaves, "
+                f"this engine's pool has {li}")
+        self.cache = jax.tree_util.tree_unflatten(treedef, out_leaves)
+        # Encoder output + source mask arrive precomputed — the whole
+        # point of the split is that the decode replica never runs the
+        # encoder for a handed-off stream. Same jitted scatter as
+        # admission (unused slots target the out-of-bounds row
+        # ``capacity`` and are dropped).
+        cap, s = self.capacity, self.max_src_len
+        enc_new = np.zeros((cap, s, self._enc_hid), self._enc_dtype)
+        mask_new = np.zeros((cap, s), np.int32)
+        row_targets = np.full((cap,), cap, np.int32)
+        for j, r in enumerate(rows):
+            enc_new[j] = artifact["enc"]
+            mask_new[j] = artifact["src_mask"]
+            row_targets[j] = r
+        self._enc, self._src_mask = self._admit_scatter_fn(
+            self._enc, self._src_mask, jnp.asarray(enc_new),
+            jnp.asarray(mask_new), jnp.asarray(row_targets))
+        if self.speculate_gamma > 0:
+            self._warm_draft_rows(artifact, rows, steps, mask_new,
+                                  row_targets)
+        g = _Group(req=req, rows=rows, budget=budget, steps=steps,
+                   committed_blocks=peak, imported_tokens=steps)
+        if w > 1:
+            g.scores = np.asarray(artifact["scores"], np.float32).copy()
+            g.beam_done = np.asarray(artifact["beam_done"], bool).copy()
+            bt = np.full((w, budget + 1), PAD_ID, np.int32)
+            src_bt = np.asarray(artifact["beam_tokens"], np.int32)
+            bt[:, :src_bt.shape[1]] = src_bt
+            g.beam_tokens = bt
+        self._groups.append(g)
+        return req
+
+    def _warm_draft_rows(self, artifact, rows: List[int], steps: int,
+                         mask_new, row_targets) -> None:
+        """Speculation on a decode replica. Self-draft: the draft cache
+        must mirror the target's K/V at positions 0..steps-1 for
+        acceptance to stay total, so the artifact's blocks are unpacked
+        densely into the draft's row table (pool leaf i ↔ dense 4-D
+        draft leaf i — same model, same tree traversal). A distinct
+        draft only gets its encoder table refreshed: its decoder cache
+        for the skipped positions stays cold, which degrades acceptance
+        but never correctness (the accept-prefix rule rejects any
+        proposal the target disagrees with)."""
+        if not self._self_draft:
+            # _draft_prefill scatters the draft encoder output for the
+            # imported source (self-draft aliases the target tables).
+            src = np.full((self.capacity, self.max_src_len), PAD_ID,
+                          np.int32)
+            src_ids = np.asarray(artifact["src_ids"], np.int32)
+            for j in range(len(rows)):
+                src[j, :len(src_ids)] = src_ids
+            self._draft_prefill(src, np.asarray(mask_new), row_targets)
+            return
+        if steps <= 0:
+            return
+        bs = self.kv_block_size
+        rbi = np.asarray(artifact["row_block_index"], np.int32)
+        dleaves, dtreedef = jax.tree_util.tree_flatten(self._draft_cache)
+        li = 0
+        out = []
+        for dleaf in dleaves:
+            if getattr(dleaf, "ndim", 0) == 4 \
+                    and dleaf.shape[0] == self.capacity:
+                payload = np.asarray(artifact[f"kv_{li}"])
+                for j, r in enumerate(rows):
+                    idxs = [int(i) for i in rbi[j] if i >= 0]
+                    # [nb_j, H, bs, D] -> [H, nb_j*bs, D], cut to steps.
+                    dense = np.concatenate(
+                        [payload[i] for i in idxs], axis=1)[:, :steps, :]
+                    dleaf = dleaf.at[r, :, :steps, :].set(
+                        jnp.asarray(dense).astype(dleaf.dtype))
+                li += 1
+            out.append(dleaf)
+        self._draft_cache = jax.tree_util.tree_unflatten(dtreedef, out)
+
+    def release_handoff(self, request_id: str) -> None:
+        """Free a parked request's rows/blocks after the decode side has
+        imported them. The request finalizes locally as PREFILLED (a
+        non-terminal marker state: the stream lives on elsewhere); its
+        prefill-side decode work is ledgered as handoff goodput and its
+        serve.request span is emitted — the prefill half of the
+        cross-replica flow link in ``obs export --fleet``."""
+        g = self._handoff_ready.pop(request_id, None)
+        if g is None:
+            raise KeyError(
+                f"no parked handoff for request {request_id!r}")
+        now = self._clock()
+        self._free_group_resources(g)
+        g.req.state = RequestState.PREFILLED
+        g.req.finished_at = now
+        self.metrics.record_finish(RequestState.PREFILLED.value,
+                                   g.req.latency_s)
+        self.metrics.record_ledger(goodput=g.decoded, wasted=0,
+                                   reason="handoff")
+        decode_s = None
+        if g.req.admitted_at is not None:
+            decode_s = max(now - g.req.admitted_at
+                           - (g.req.prefill_s or 0.0), 0.0)
+        self.metrics.record_phases(g.req.prefill_s, decode_s)
+        self.metrics.record_request_trace(g.req)
 
     def run_until_drained(self, max_steps: int = 1_000_000,
                           writer=None, emit_every: int = 0) -> int:

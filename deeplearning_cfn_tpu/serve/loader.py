@@ -31,6 +31,7 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
                 speculate_gamma: int = 0,
                 draft_cfg: Optional[ExperimentConfig] = None,
                 quantize: str = "",
+                phase: str = "both",
                 step: int = 0, vocab: str = "", allow_init: bool = False,
                 clock=time.monotonic) -> Tuple[Engine, object, int]:
     """Build an Engine from a trained experiment.
@@ -121,6 +122,7 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
         speculate_gamma=speculate_gamma,
         draft_model=draft_model, draft_variables=draft_variables,
         quantize=quantize,
+        phase=phase,
         clock=clock)
     engine.metrics.ckpt_load_retries = manager.store_retries()
     return engine, bpe, int(at_step)

@@ -44,6 +44,11 @@ class RequestState(enum.Enum):
     DONE = "done"
     CANCELLED = "cancelled"
     EXPIRED = "expired"
+    # Disaggregated serving: prefill finished on a prefill-phase engine
+    # and the request is parked awaiting KV handoff to a decode replica.
+    # NOT a terminal state — the stream resumes (as a new attempt) on the
+    # decode side, so ``finished`` stays False.
+    PREFILLED = "prefilled"
 
 
 class OverloadError(RuntimeError):
@@ -254,6 +259,16 @@ class RequestQueue:
         that doesn't fit."""
         with self._lock:
             self._pending.insert(0, req)
+
+    def adopt(self, req: Request) -> None:
+        """Register an externally-constructed request (a KV-handoff import
+        on a decode replica) so poll/cancel see it. The request never sat
+        in ``_pending`` — it was admitted the moment it was imported — so
+        it doesn't count against ``max_depth``."""
+        with self._lock:
+            if req.id in self._by_id:
+                raise ValueError(f"duplicate request id {req.id!r}")
+            self._by_id[req.id] = req
 
     def poll(self, request_id: str) -> Request:
         with self._lock:

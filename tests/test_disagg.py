@@ -1,0 +1,492 @@
+"""Disaggregated prefill/decode serving tests: the KV handoff codec
+(export → store → import round trip, partial tail blocks, beam prefix
+sharing, free-list-independent remap), engine-pair token parity (greedy,
+beam, speculation), the router's phase-aware placement and handoff hop,
+phase-aware rollout/evacuation, and the bench/report surfaces.
+
+The contract under test everywhere: splitting the fleet into prefill
+and decode replicas must be invisible in outputs — token-identical to a
+co-located run of the same trace — while zero requests drop.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.ckpt.store import MemoryObjectStore
+from deeplearning_cfn_tpu.fleet import (
+    EngineReplica,
+    ReplicaState,
+    Router,
+    rolling_upgrade,
+)
+from deeplearning_cfn_tpu.runtime.faults import FaultPlan, FaultSpec
+from deeplearning_cfn_tpu.serve.handoff import (
+    drop_handoff,
+    load_handoff,
+    save_handoff,
+    validate_artifact,
+)
+from deeplearning_cfn_tpu.serve.queue import OverloadError
+
+
+@pytest.fixture(scope="module")
+def tiny_disagg_setup():
+    """One tiny NMT init shared by every engine in this module, a fixed
+    trace, and paged single-engine baselines (greedy per-request token
+    lists, plus a beam baseline for trace[1])."""
+    import jax
+
+    from deeplearning_cfn_tpu.models.transformer_nmt import (
+        transformer_nmt_tiny,
+    )
+    from deeplearning_cfn_tpu.serve.bench import _fixed_trace
+    from deeplearning_cfn_tpu.serve.engine import Engine
+
+    src_len, max_new = 8, 4
+    model = transformer_nmt_tiny(vocab_size=96, max_len=64)
+    init = model.init(
+        jax.random.PRNGKey(0),
+        np.zeros((1, src_len), np.int32), np.ones((1, src_len), np.int32),
+        np.zeros((1, src_len), np.int32), train=False)
+    variables = {"params": init["params"]}
+    trace = _fixed_trace(6, src_len, 96, seed=0)
+
+    def make_engine(phase, kv_block_size=4, speculate_gamma=0):
+        return Engine(model, variables, capacity=2, max_src_len=src_len,
+                      queue_depth=len(trace),
+                      default_max_new_tokens=max_new, decode_window=2,
+                      kv_block_size=kv_block_size,
+                      speculate_gamma=speculate_gamma, phase=phase)
+
+    baseline_engine = make_engine("both")
+    ids = [baseline_engine.submit(src, max_new_tokens=max_new).id
+           for src in trace]
+    baseline_engine.run_until_drained()
+    baseline = [list(baseline_engine.poll(i).tokens) for i in ids]
+    beam_req = make_engine("both")
+    rb = beam_req.submit(trace[1], max_new_tokens=max_new, beam_size=2)
+    beam_req.run_until_drained()
+    beam_baseline = list(beam_req.poll(rb.id).tokens)
+
+    return {"trace": trace, "baseline": baseline,
+            "beam_baseline": beam_baseline, "variables": variables,
+            "max_new": max_new, "src_len": src_len,
+            "make_engine": make_engine}
+
+
+def _park_one(engine, src, max_new, **submit_kwargs):
+    req = engine.submit(src, max_new_tokens=max_new, **submit_kwargs)
+    engine.run_until_drained()
+    assert engine.handoff_ready(req.id)
+    return req
+
+
+def _route_all(router, trace, max_new):
+    rids = []
+    for src in trace:
+        while True:
+            try:
+                rids.append(router.submit(src, max_new_tokens=max_new))
+                break
+            except OverloadError:
+                router.step()
+    return rids
+
+
+# -- handoff codec -----------------------------------------------------------
+
+
+def test_handoff_codec_round_trips_through_store(tiny_disagg_setup):
+    """Every artifact array survives save → load byte-identically, and
+    drop removes the object."""
+    s = tiny_disagg_setup
+    pre = s["make_engine"]("prefill")
+    req = _park_one(pre, s["trace"][0], s["max_new"])
+    art = pre.export_handoff(req.id)
+    store = MemoryObjectStore()
+    nbytes = save_handoff(store, "handoff/t0", art)
+    assert nbytes > 0
+    loaded = load_handoff(store, "handoff/t0")
+    assert set(loaded) == set(art)
+    for k in art:
+        np.testing.assert_array_equal(np.asarray(loaded[k]),
+                                      np.asarray(art[k]), err_msg=k)
+    validate_artifact(loaded)
+    drop_handoff(store, "handoff/t0")
+    with pytest.raises(FileNotFoundError):
+        load_handoff(store, "handoff/t0")
+    pre.release_handoff(req.id)
+
+
+def test_handoff_codec_round_trips_bfloat16_leaves():
+    """A bfloat16 cache (the wmt preset on TPU) must survive the npz
+    transport: numpy reloads raw ml_dtypes arrays as void records, so
+    the codec ships them as byte views with a dtype tag."""
+    import ml_dtypes
+
+    from deeplearning_cfn_tpu.serve.handoff import pack_meta
+
+    rng = np.random.default_rng(0)
+    kv = rng.standard_normal((2, 2, 4, 3)).astype(ml_dtypes.bfloat16)
+    enc = rng.standard_normal((8, 16)).astype(ml_dtypes.bfloat16)
+    art = {
+        "meta": pack_meta(version=1, width=1, steps=1, budget=4,
+                          kv_block_size=4, model_max_len=64,
+                          max_src_len=8, enc_hid=16),
+        "row_block_index": np.array([[0, 1]], np.int32),
+        "kv_0": kv, "enc": enc,
+        "src_mask": np.ones((8,), np.int32),
+        "src_ids": np.arange(3, 11, dtype=np.int32),
+        "tokens": np.array([7], np.int32),
+        "prev": np.array([7], np.int32),
+        "pos": np.array([1], np.int32),
+        "deadline": np.array([np.nan], np.float64),
+    }
+    store = MemoryObjectStore()
+    save_handoff(store, "handoff/bf16", art)
+    loaded = load_handoff(store, "handoff/bf16")
+    assert loaded["kv_0"].dtype == ml_dtypes.bfloat16
+    assert loaded["enc"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(loaded["kv_0"].view(np.uint16),
+                                  kv.view(np.uint16))
+    np.testing.assert_array_equal(loaded["enc"].view(np.uint16),
+                                  enc.view(np.uint16))
+    assert loaded["src_mask"].dtype == np.int32
+
+
+def test_handoff_artifact_partial_tail_block(tiny_disagg_setup):
+    """Prefill parks after exactly one decode step, so with block size 4
+    the exported tail block is partial: the artifact still carries whole
+    blocks, indexed per row, with pos marking the real fill level."""
+    s = tiny_disagg_setup
+    pre = s["make_engine"]("prefill")
+    req = _park_one(pre, s["trace"][2], s["max_new"])
+    art = pre.export_handoff(req.id)
+    meta = validate_artifact(art)
+    assert meta["steps"] == 1 and meta["width"] == 1
+    assert meta["kv_block_size"] == 4
+    rbi = np.asarray(art["row_block_index"])
+    # One partially-filled block bound for the single row.
+    assert (rbi[0] >= 0).sum() == 1
+    assert art["kv_0"].shape[0] == 1          # n_unique blocks
+    assert art["kv_0"].shape[2] == 4          # whole block exported
+    assert list(art["pos"]) == [1]            # ...but only 1 position live
+    pre.release_handoff(req.id)
+
+
+def test_handoff_beam_shared_prefix_reshared_by_refcount(tiny_disagg_setup):
+    """Beam rows sharing a prefix block export ONE copy (same artifact
+    index in both rows) and the importer re-shares it: both decode-side
+    rows bind the same remapped block at refcount 2."""
+    s = tiny_disagg_setup
+    # Block size 1: the first step fills a whole block, so the beam fork
+    # shares it by refcount instead of copying the tail.
+    pre = s["make_engine"]("prefill", kv_block_size=1)
+    dec = s["make_engine"]("decode", kv_block_size=1)
+    req = _park_one(pre, s["trace"][1], s["max_new"], beam_size=2)
+    art = pre.export_handoff(req.id)
+    rbi = np.asarray(art["row_block_index"])
+    assert rbi[0, 0] == rbi[1, 0]             # shared artifact index
+    assert art["kv_0"].shape[0] == 1          # exported once
+    new = dec.import_handoff(art, request_id=req.id + "#a1")
+    g = dec._groups[-1]
+    bounds = [dec._blocks_bound[r] for r in g.rows]
+    assert bounds[0][0] == bounds[1][0]
+    assert dec.allocator.refcount(bounds[0][0]) == 2
+    pre.release_handoff(req.id)
+    dec.run_until_drained()
+    assert dec.poll(new.id).state.value == "done"
+
+
+def test_import_remaps_block_ids_through_importer_free_list(
+        tiny_disagg_setup):
+    """The artifact carries pool-independent indices: an importer whose
+    free list is in a different order maps them onto different physical
+    block ids and still resumes to identical tokens."""
+    s = tiny_disagg_setup
+    pre = s["make_engine"]("prefill")
+    dec = s["make_engine"]("decode")
+    # Scramble the importer's free list: cycle a few blocks through
+    # alloc/free so the next pops return different ids than a fresh pool.
+    held = [dec.allocator.alloc() for _ in range(3)]
+    for b in held:
+        dec.allocator.free(b)
+    req = _park_one(pre, s["trace"][0], s["max_new"])
+    art = pre.export_handoff(req.id)
+    n_unique = int(art["kv_0"].shape[0])
+    new = dec.import_handoff(art, request_id=req.id + "#a1")
+    assert dec.allocator.blocks_in_use == n_unique
+    pre.release_handoff(req.id)
+    dec.run_until_drained()
+    assert list(dec.poll(new.id).tokens) == s["baseline"][0]
+
+
+def test_import_rejects_mismatched_geometry(tiny_disagg_setup):
+    s = tiny_disagg_setup
+    pre = s["make_engine"]("prefill")
+    req = _park_one(pre, s["trace"][0], s["max_new"])
+    art = pre.export_handoff(req.id)
+    other = s["make_engine"]("decode", kv_block_size=2)
+    with pytest.raises(ValueError, match="kv_block_size"):
+        other.import_handoff(art, request_id="x#a1")
+    # The exporter's parked state is untouched — a later retry works.
+    dec = s["make_engine"]("decode")
+    new = dec.import_handoff(art, request_id=req.id + "#a1")
+    pre.release_handoff(req.id)
+    dec.run_until_drained()
+    assert list(dec.poll(new.id).tokens) == s["baseline"][0]
+
+
+# -- engine-pair parity ------------------------------------------------------
+
+
+def test_disagg_pair_token_parity_greedy(tiny_disagg_setup):
+    """Prefill engine → store codec → decode engine, whole trace: the
+    split is invisible — token-identical to the co-located baseline."""
+    s = tiny_disagg_setup
+    pre = s["make_engine"]("prefill")
+    dec = s["make_engine"]("decode")
+    store = MemoryObjectStore()
+    out = []
+    for i, src in enumerate(s["trace"]):
+        req = _park_one(pre, src, s["max_new"])
+        save_handoff(store, f"handoff/{req.id}", pre.export_handoff(req.id))
+        new = dec.import_handoff(load_handoff(store, f"handoff/{req.id}"),
+                                 request_id=f"{req.id}#a1",
+                                 trace_id=req.id)
+        pre.release_handoff(req.id)
+        drop_handoff(store, f"handoff/{req.id}")
+        dec.run_until_drained()
+        out.append(list(dec.poll(new.id).tokens))
+    assert out == s["baseline"]
+
+
+def test_disagg_pair_token_parity_beam(tiny_disagg_setup):
+    s = tiny_disagg_setup
+    pre = s["make_engine"]("prefill")
+    dec = s["make_engine"]("decode")
+    req = _park_one(pre, s["trace"][1], s["max_new"], beam_size=2)
+    art = pre.export_handoff(req.id)
+    new = dec.import_handoff(art, request_id=req.id + "#a1")
+    pre.release_handoff(req.id)
+    dec.run_until_drained()
+    assert list(dec.poll(new.id).tokens) == s["beam_baseline"]
+
+
+def test_disagg_decode_replica_speculation_parity(tiny_disagg_setup):
+    """Self-draft speculation on the decode replica: the import warms the
+    draft cache from the artifact's blocks, and the accept-prefix rule
+    keeps the resumed stream exact — same tokens as the plain baseline."""
+    s = tiny_disagg_setup
+    pre = s["make_engine"]("prefill")
+    dec = s["make_engine"]("decode", speculate_gamma=2)
+    req = _park_one(pre, s["trace"][0], s["max_new"])
+    art = pre.export_handoff(req.id)
+    new = dec.import_handoff(art, request_id=req.id + "#a1")
+    pre.release_handoff(req.id)
+    dec.run_until_drained()
+    assert list(dec.poll(new.id).tokens) == s["baseline"][0]
+
+
+# -- router: phase-aware placement and the handoff hop -----------------------
+
+
+def test_router_places_submissions_on_prefill_only(tiny_disagg_setup):
+    s = tiny_disagg_setup
+    pre = EngineReplica("prefill-0", s["make_engine"]("prefill"))
+    dec = EngineReplica("decode-0", s["make_engine"]("decode"))
+    router = Router([pre, dec], policy="least_loaded")
+    assert router.disaggregated
+    for src in s["trace"][:2]:
+        router.submit(src, max_new_tokens=s["max_new"])
+    assert pre.engine.queue.depth + pre.engine.active_requests == 2
+    assert dec.engine.queue.depth + dec.engine.active_requests == 0
+
+
+def test_router_disagg_hop_parity_and_ledger(tiny_disagg_setup):
+    """End-to-end through the router: every stream prefills on
+    prefill-0, hops through the store codec, finishes on decode-0 —
+    zero drops, token parity, and the phase ledger records the hop as
+    its own ``handoff_s`` phase (co-located entries keep the plain
+    five-phase shape)."""
+    s = tiny_disagg_setup
+    router = Router([EngineReplica("prefill-0", s["make_engine"]("prefill")),
+                     EngineReplica("decode-0", s["make_engine"]("decode"))],
+                    policy="least_loaded")
+    rids = _route_all(router, s["trace"], s["max_new"])
+    router.run_until_drained()
+    results = [router.result(rid) for rid in rids]
+    assert all(r["state"] == "done" for r in results)
+    assert [r["tokens"] for r in results] == s["baseline"]
+    stats = router.stats()
+    assert stats["dropped_requests"] == 0
+    assert stats["handoffs"] == len(rids)
+    assert stats["handoff_bytes"] > 0
+    assert stats["replicas"]["prefill-0"]["phase"] == "prefill"
+    assert stats["replicas"]["decode-0"]["phase"] == "decode"
+    for rid in rids:
+        entry = router.ledger[rid]
+        assert entry["replicas"] == ["prefill-0", "decode-0"]
+        assert entry["phases"]["handoff_s"] >= 0.0
+        assert entry["phases"]["prefill_s"] is not None
+    # Co-located control: same trace, no hop, no handoff_s key.
+    co = Router([EngineReplica("replica-0", s["make_engine"]("both"))],
+                policy="least_loaded")
+    co_rids = _route_all(co, s["trace"], s["max_new"])
+    co.run_until_drained()
+    assert [co.result(r)["tokens"] for r in co_rids] == s["baseline"]
+    for rid in co_rids:
+        assert set(co.ledger[rid]["phases"]) == {
+            "queue_wait_s", "prefill_s", "decode_s", "stall_s", "emit_s"}
+
+
+def test_router_disagg_chaos_kill_decode_replica(tiny_disagg_setup):
+    """A decode replica dies mid-decode: its streams are evacuated,
+    re-prefilled, and hop to the surviving decode replica — zero drops
+    and the aggregate stays token-identical."""
+    s = tiny_disagg_setup
+    plan = FaultPlan([FaultSpec(op="step", key="decode-0", kind="crash",
+                                at_calls=(3,))])
+    reps = [
+        EngineReplica("prefill-0", s["make_engine"]("prefill"),
+                      fault_plan=plan),
+        EngineReplica("decode-0", s["make_engine"]("decode"),
+                      fault_plan=plan),
+        EngineReplica("decode-1", s["make_engine"]("decode"),
+                      fault_plan=plan),
+    ]
+    router = Router(reps, policy="least_loaded")
+    rids = _route_all(router, s["trace"], s["max_new"])
+    router.run_until_drained()
+    assert reps[1].state is ReplicaState.DOWN
+    assert router.evacuations >= 1
+    results = [router.result(rid) for rid in rids]
+    assert all(r["state"] == "done" for r in results)
+    assert router.stats()["dropped_requests"] == 0
+    assert [r["tokens"] for r in results] == s["baseline"]
+    # The evacuated streams re-prefilled and hopped a second time.
+    assert router.stats()["handoffs"] > len(rids) - 1
+
+
+def test_rolling_upgrade_disagg_drains_decode_first(tiny_disagg_setup):
+    """Phase-aware rollout: decode replicas upgrade before prefill ones
+    (new weights are probed on the decode path before prefill produces
+    new-weight artifacts), probes release parked prefill state, and the
+    fleet keeps serving with token parity afterwards."""
+    s = tiny_disagg_setup
+    router = Router([EngineReplica("prefill-0", s["make_engine"]("prefill")),
+                     EngineReplica("decode-0", s["make_engine"]("decode"))],
+                    policy="least_loaded")
+    report = rolling_upgrade(router, s["variables"])
+    assert report.ok and len(report.upgraded) == 2
+    assert [r.replica for r in report.results] == \
+        ["decode-0", "prefill-0"]
+    assert [r.phase for r in report.results] == ["decode", "prefill"]
+    assert all(r.swapped and r.probe_ok for r in report.results)
+    for rid in router.replica_ids():
+        assert router.replica(rid).state is ReplicaState.HEALTHY
+    rids = _route_all(router, s["trace"], s["max_new"])
+    router.run_until_drained()
+    assert [router.result(r)["tokens"] for r in rids] == s["baseline"]
+    assert router.stats()["dropped_requests"] == 0
+
+
+# -- bench, CLI, report surfaces ---------------------------------------------
+
+
+def test_fleet_bench_rejects_lopsided_disagg_and_bad_mix():
+    from deeplearning_cfn_tpu.fleet.bench import run_fleet_bench
+
+    with pytest.raises(ValueError, match="prefill"):
+        run_fleet_bench(prefill_replicas=1, decode_replicas=0, smoke=True)
+    with pytest.raises(ValueError, match="trace mix"):
+        run_fleet_bench(trace_mix="decode-heavy", smoke=True)
+
+
+@pytest.mark.slow
+def test_fleet_bench_disagg_smoke_contract():
+    """The bench contract run: a 1+1 disagg fleet is token-identical to
+    both the single-engine oracle and a co-located fleet on the same
+    trace, drops nothing, and reports the handoff economics."""
+    from deeplearning_cfn_tpu.fleet.bench import run_fleet_bench
+
+    r = run_fleet_bench(smoke=True, prefill_replicas=1, decode_replicas=1)
+    assert r["prefill_replicas"] == 1 and r["decode_replicas"] == 1
+    assert r["dropped_requests"] == 0
+    assert r["token_identical"] is True
+    assert r["token_identical_colocated"] is True
+    assert r["goodput_sum_ok"] is True
+    assert r["handoffs"] >= 1 and r["handoff_bytes"] > 0
+    assert r["handoff_latency_p50_s"] is not None
+    assert {row["phase"] for row in r["per_replica"]} == \
+        {"prefill", "decode"}
+
+
+def test_cli_disagg_flags_parse():
+    from deeplearning_cfn_tpu.cli.main import build_parser, main
+
+    parser = build_parser()
+    up = parser.parse_args(["fleet", "up", "--preset", "p",
+                            "--requests", "r.jsonl",
+                            "--prefill", "2", "--decode", "3",
+                            "--kv-block-size", "8"])
+    assert up.fn.__name__ == "_cmd_fleet_up"
+    assert up.prefill == 2 and up.decode == 3 and up.kv_block_size == 8
+    be = parser.parse_args(["bench", "--fleet", "--smoke",
+                            "--fleet-prefill", "1", "--fleet-decode", "1",
+                            "--trace-mix", "prefill-heavy"])
+    assert be.fleet_prefill == 1 and be.fleet_decode == 1
+    assert be.trace_mix == "prefill-heavy"
+    # A prefill pool without a decode pool is refused up front.
+    assert main(["fleet", "up", "--preset", "p", "--requests", "r.jsonl",
+                 "--prefill", "2"]) == 2
+
+
+def test_summarize_fleet_reports_phase_and_queue_depth(tmp_path):
+    """obs summarize --fleet over a disagg run dir: per-replica phase
+    roles and the per-phase queue depth aggregate, both in the dict and
+    in the rendered report."""
+    from deeplearning_cfn_tpu.obs.report import (
+        render_fleet_report,
+        summarize_fleet,
+    )
+
+    root = tmp_path / "run"
+    for name, phase, depth in (("prefill-0", "prefill", 3),
+                               ("decode-0", "decode", 1)):
+        d = root / name
+        d.mkdir(parents=True)
+        rec = {"serve_submitted": 4, "serve_admitted": 4,
+               "serve_completed": 4, "serve_tokens_generated": 16,
+               "serve_tokens_per_sec": 8.0, "serve_queue_depth": depth,
+               "phase": phase, "replica": name}
+        (d / "metrics.jsonl").write_text(json.dumps(rec) + "\n")
+    summary = summarize_fleet(str(root))
+    assert summary["replicas"]["prefill-0"]["serve"]["phase"] == "prefill"
+    assert summary["replicas"]["decode-0"]["serve"]["phase"] == "decode"
+    assert summary["fleet"]["queue_depth_by_phase"] == \
+        {"prefill": 3, "decode": 1}
+    text = render_fleet_report(summary)
+    assert "queue depth by phase: decode=1  prefill=3" in text
+    assert "phase prefill (q 3)" in text
+    assert "phase decode (q 1)" in text
+
+
+def test_fleet_status_cli_on_disagg_run(tmp_path, capsys):
+    from deeplearning_cfn_tpu.cli.main import main
+
+    root = tmp_path / "run"
+    for name, phase in (("prefill-0", "prefill"), ("decode-0", "decode")):
+        d = root / name
+        d.mkdir(parents=True)
+        rec = {"serve_submitted": 2, "serve_completed": 2,
+               "serve_tokens_generated": 8, "serve_queue_depth": 0,
+               "phase": phase}
+        (d / "metrics.jsonl").write_text(json.dumps(rec) + "\n")
+    assert main(["fleet", "status", str(root)]) == 0
+    out = capsys.readouterr().out
+    assert "fleet 2 replica(s)" in out
+    assert "phase prefill" in out and "phase decode" in out
