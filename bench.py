@@ -212,7 +212,8 @@ def _finalize_green(record: dict, alive: bool, probe_note: str,
                     "decode_p95_colocated", "decode_p95_disagg",
                     "decode_p95_no_adversary",
                     "handoff_latency_p50_s", "handoff_latency_p95_s",
-                    "handoff_bytes"):
+                    "handoff_bytes", "kv_cache_bytes",
+                    "spec_chain_len_p50", "host_syncs_per_token"):
             if key in record:
                 record[key] = None
     return record

@@ -360,7 +360,10 @@ def _cmd_bench(args) -> int:
                                trace_dir=args.fleet_trace_dir,
                                prefill_replicas=args.fleet_prefill,
                                decode_replicas=args.fleet_decode,
-                               trace_mix=args.trace_mix)
+                               trace_mix=args.trace_mix,
+                               speculate=args.speculate,
+                               speculate_device=args.speculate_device,
+                               kv_quant=args.kv_quant)
         print(json.dumps(line))
         return 0
     if getattr(args, "obs_smoke", False):
@@ -388,7 +391,10 @@ def _cmd_bench(args) -> int:
                                prefix_cache=args.prefix_cache,
                                prefix_dup=args.prefix_dup,
                                speculate=args.speculate,
+                               speculate_device=args.speculate_device,
+                               draft=args.draft,
                                quantize=args.quantize,
+                               kv_quant=args.kv_quant,
                                smoke=args.smoke)
         print(json.dumps(line))
         # The speculative contract is token-identity with plain greedy;
@@ -401,6 +407,10 @@ def _cmd_bench(args) -> int:
         if line.get("divergence_ok") is False:
             print("[dlcfn-tpu] int8 logits divergence exceeded the "
                   "bound", file=sys.stderr)
+            return 1
+        if line.get("kv_divergence_ok") is False:
+            print("[dlcfn-tpu] int8 KV-cache logits divergence exceeded "
+                  "the bound", file=sys.stderr)
             return 1
         return 0
     if getattr(args, "sweep_batches", None):
@@ -493,7 +503,10 @@ def _cmd_serve(args) -> int:
             decode_window=args.decode_window,
             kv_block_size=args.kv_block_size, kv_blocks=args.kv_blocks,
             prefix_cache_size=args.prefix_cache,
-            speculate_gamma=args.speculate, quantize=args.quantize,
+            speculate_gamma=args.speculate,
+            speculate_device=args.speculate_device,
+            draft_cfg=args.draft or None,
+            quantize=args.quantize, kv_quant=args.kv_quant,
             step=args.step, vocab=args.vocab, allow_init=args.allow_init)
     except (FileNotFoundError, ValueError) as e:
         print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
@@ -663,7 +676,9 @@ def _fleet_build_replicas(args, n: int, specs=None, kv_block_size: int = 0):
             decode_window=args.decode_window,
             kv_block_size=kv_block_size,
             speculate_gamma=getattr(args, "speculate", 0),
+            speculate_device=getattr(args, "speculate_device", False),
             quantize=getattr(args, "quantize", ""),
+            kv_quant=getattr(args, "kv_quant", ""),
             phase=phase,
             vocab=args.vocab, allow_init=args.allow_init)
         replicas.append(EngineReplica(name, engine))
@@ -829,8 +844,12 @@ def _cmd_fleet_up(args) -> int:
                 "--emit-every", str(args.emit_every)]
         if getattr(args, "speculate", 0):
             argv += ["--speculate", str(args.speculate)]
+        if getattr(args, "speculate_device", False):
+            argv += ["--speculate-device"]
         if getattr(args, "quantize", ""):
             argv += ["--quantize", args.quantize]
+        if getattr(args, "kv_quant", ""):
+            argv += ["--kv-quant", args.kv_quant]
         if args.accelerator:
             argv += ["--accelerator", args.accelerator]
         if args.vocab:
@@ -1556,10 +1575,24 @@ def build_parser() -> argparse.ArgumentParser:
                          "verify step (0 = off); self-draft without a "
                          "separate draft checkpoint — greedy output stays "
                          "token-identical either way")
+    sv.add_argument("--speculate-device", action="store_true",
+                    help="chain speculative gamma-windows on device "
+                         "(draft-verify-accept-advance in one jitted "
+                         "scan, one host sync per chain; requires "
+                         "--speculate > 0, token output unchanged)")
+    sv.add_argument("--draft", default="",
+                    help="committed distilled-draft preset for "
+                         "--speculate (e.g. tiny-distilled; empty = "
+                         "self-draft)")
     sv.add_argument("--quantize", default="", choices=["", "int8"],
                     help="weight-only quantization for serving (int8 = "
                          "per-channel symmetric, ~4x smaller weights; "
                          "checkpoints stay fp32 on disk)")
+    sv.add_argument("--kv-quant", default="", choices=["", "int8"],
+                    help="paged KV-cache quantization: int8 block codes "
+                         "+ per-block scales (~4x smaller KV pool, "
+                         "bounded logits divergence; needs "
+                         "--kv-block-size > 0)")
     sv.add_argument("--vocab", default="",
                     help="BPE vocab.json — required for \"text\" requests")
     sv.add_argument("--step", type=int, default=0,
@@ -1600,10 +1633,17 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("--speculate", type=int, default=0,
                        help="per-replica speculative decode draft depth "
                             "(0 = off; self-draft)")
+        p.add_argument("--speculate-device", action="store_true",
+                       help="per-replica device-resident speculative "
+                            "chains (requires --speculate > 0)")
         p.add_argument("--quantize", default="", choices=["", "int8"],
                        help="per-replica weight-only quantization; "
                             "rolling upgrades re-quantize the incoming "
                             "fp32 checkpoint on swap")
+        p.add_argument("--kv-quant", default="", choices=["", "int8"],
+                       help="per-replica int8 paged KV cache (needs the "
+                            "paged path; disagg topologies are paged "
+                            "already)")
         p.add_argument("--vocab", default="",
                        help="BPE vocab.json — required for \"text\" "
                             "requests")
@@ -1762,10 +1802,25 @@ def build_parser() -> argparse.ArgumentParser:
                          "depth γ (self-draft); the record gains "
                          "spec_accept_rate / tokens_per_target_step and "
                          "the run fails on a greedy-parity break")
+    be.add_argument("--speculate-device", action="store_true",
+                    help="serving scenario: device-resident speculative "
+                         "chains; the record gains spec_chain_len_p50 "
+                         "and host_syncs_per_token (plus the host-path "
+                         "comparison number)")
+    be.add_argument("--draft", default="self",
+                    help="serving scenario: draft for --speculate — "
+                         "'self' (acceptance ceiling) or a committed "
+                         "preset like 'tiny-distilled' (measured accept "
+                         "rate)")
     be.add_argument("--quantize", default="", choices=["", "int8"],
                     help="serving scenario: weight-only quantization; "
                          "the record reports weight_bytes vs fp32 and a "
                          "bounded logits-divergence check")
+    be.add_argument("--kv-quant", default="", choices=["", "int8"],
+                    help="serving scenario: int8 paged KV cache; the "
+                         "record reports kv_cache_bytes vs fp32 and a "
+                         "bounded KV logits-divergence check (the run "
+                         "fails when it exceeds the bound)")
     be.add_argument("--smoke", action="store_true",
                     help="serving scenario: CI fast mode (few requests, "
                          "tiny budget, same record contract)")

@@ -42,16 +42,23 @@ UNIT = "tokens/sec"
 def _single_engine_tokens(model, variables, pairs, slots: int,
                           src_len: int, max_new_tokens: int,
                           decode_window: int,
-                          kv_block_size: int = 0) -> List[List[int]]:
+                          kv_block_size: int = 0,
+                          speculate: int = 0,
+                          speculate_device: bool = False,
+                          kv_quant: str = "") -> List[List[int]]:
     """The baseline: the same (src, budget) trace through ONE engine;
     returns the per-trace-index token lists the fleet output must
     match. ``kv_block_size > 0`` runs the paged path (the disagg
-    topologies are paged, so their baseline is too)."""
+    topologies are paged, so their baseline is too). The speculation and
+    KV-quant knobs mirror the fleet's so parity stays apples-to-apples."""
     engine = Engine(model, variables, capacity=slots, max_src_len=src_len,
                     queue_depth=len(pairs) + 1,
                     default_max_new_tokens=max_new_tokens,
                     decode_window=decode_window,
-                    kv_block_size=kv_block_size)
+                    kv_block_size=kv_block_size,
+                    speculate_gamma=speculate,
+                    speculate_device=speculate_device,
+                    kv_quant=kv_quant)
     ids = []
     for src, budget in pairs:
         while True:
@@ -96,7 +103,10 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
                     prefill_replicas: int = 0,
                     decode_replicas: int = 0,
                     trace_mix: str = "uniform",
-                    trace: Optional[List[List[int]]] = None) -> Dict:
+                    trace: Optional[List[List[int]]] = None,
+                    speculate: int = 0,
+                    speculate_device: bool = False,
+                    kv_quant: str = "") -> Dict:
     """Route the fixed trace across the fleet to drain; return the
     BENCH-contract record with the fleet fields. ``smoke`` shrinks the
     scenario AND runs the single-engine parity baseline (the t1.sh gate
@@ -120,6 +130,11 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
 
     ``trace`` overrides the generated prompts (one src-id list per
     request, each decoded to the full budget).
+
+    ``speculate``/``speculate_device``/``kv_quant`` thread the serve
+    engine's speculative-decoding and int8 KV-cache knobs through every
+    replica AND the single-engine parity baseline (``kv_quant`` forces
+    the paged path fleet-wide, since int8 blocks only exist there).
 
     ``trace_dir`` arms fleet tracing: each replica writes its span shard
     to ``<dir>/<replica>/metrics.jsonl``, the router writes its
@@ -167,7 +182,7 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
     # block-structured); the co-located contract fleet and the parity
     # baseline use the same block size so the comparison is
     # apples-to-apples.
-    kv_block_size = 4 if disagg else 0
+    kv_block_size = 4 if (disagg or kv_quant) else 0
 
     fault_plan = None
     if chaos_kill_step > 0:
@@ -187,6 +202,9 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
                             default_max_new_tokens=max_new_tokens,
                             decode_window=decode_window,
                             kv_block_size=kv_block_size,
+                            speculate_gamma=speculate,
+                            speculate_device=speculate_device,
+                            kv_quant=kv_quant,
                             phase=phase)
             rep = EngineReplica(name, engine, fault_plan=plan)
             # Warmup per replica, outside the timed window (each engine
@@ -324,7 +342,9 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
     if smoke:
         baseline = _single_engine_tokens(
             model, variables, pairs, slots, src_len, max_new_tokens,
-            decode_window, kv_block_size=kv_block_size)
+            decode_window, kv_block_size=kv_block_size,
+            speculate=speculate, speculate_device=speculate_device,
+            kv_quant=kv_quant)
         fleet_tokens = [r["tokens"] for r in results]
         token_identical = fleet_tokens == baseline
 
@@ -362,6 +382,9 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         "prefill_replicas": prefill_replicas,
         "decode_replicas": decode_replicas,
         "trace_mix": trace_mix,
+        "spec_gamma": speculate,
+        "speculate_device": speculate_device,
+        "kv_quant": kv_quant,
     }
 
     if disagg:

@@ -127,6 +127,15 @@ class MultiHeadAttention(nn.Module):
     whatever they left. With ``max_blocks * kv_block_size ==
     max_decode_len`` the gathered K/V span equals the dense row, so the
     attention output is bit-identical to the ``decode_pos`` path.
+
+    ``kv_quant="int8"`` (paged mode only) stores the block pool as int8
+    codes plus per-block/per-head float32 absmax scales
+    (``cached_key_scale`` / ``cached_value_scale`` [kv_num_blocks, H]) —
+    the KIVI-style layout that quarters KV bytes. Writes requantize the
+    touched block window (gather → dequant → insert → rescale → scatter);
+    the attend gather dequantizes through the block table. Divergence
+    from the fp pool is bounded by the per-block rounding step, the same
+    contract ``--quantize`` carries for weights.
     """
 
     num_heads: int
@@ -134,6 +143,7 @@ class MultiHeadAttention(nn.Module):
     dropout_rate: float = 0.0
     attention_impl: str = "auto"
     quantized: bool = False
+    kv_quant: str = ""
 
     def core_attention(self, q, k, v, bias, causal):
         """The [B,H,S,D] attention op. Subclasses swap this for a
@@ -182,17 +192,101 @@ class MultiHeadAttention(nn.Module):
             b = q.shape[0]
             pool_shape = (kv_num_blocks, self.num_heads, kv_block_size,
                           head_dim)
+            if self.kv_quant and self.kv_quant != "int8":
+                raise ValueError(
+                    f"unsupported kv_quant {self.kv_quant!r} "
+                    "(supported: int8)")
             is_initialized = self.has_variable("cache", "cached_key")
-            ck = self.variable("cache", "cached_key",
-                               lambda: jnp.zeros(pool_shape, self.dtype))
-            cv = self.variable("cache", "cached_value",
-                               lambda: jnp.zeros(pool_shape, self.dtype))
+            if self.kv_quant:
+                # Int8 pool + per-block/per-head absmax scale sidecars.
+                # The scale leaves sit alphabetically next to their code
+                # pools in the cache tree, so everything that walks pool
+                # leaves (COW forks, handoff) sees code → scale pairs.
+                ck = self.variable("cache", "cached_key",
+                                   lambda: jnp.zeros(pool_shape, jnp.int8))
+                cks = self.variable(
+                    "cache", "cached_key_scale",
+                    lambda: jnp.ones((kv_num_blocks, self.num_heads),
+                                     jnp.float32))
+                cv = self.variable("cache", "cached_value",
+                                   lambda: jnp.zeros(pool_shape, jnp.int8))
+                cvs = self.variable(
+                    "cache", "cached_value_scale",
+                    lambda: jnp.ones((kv_num_blocks, self.num_heads),
+                                     jnp.float32))
+            else:
+                ck = self.variable("cache", "cached_key",
+                                   lambda: jnp.zeros(pool_shape, self.dtype))
+                cv = self.variable("cache", "cached_value",
+                                   lambda: jnp.zeros(pool_shape, self.dtype))
+                cks = cvs = None
             max_blocks = block_tables.shape[1]
             span = max_blocks * kv_block_size
             s = q.shape[2]
             if is_initialized:
                 rows = jnp.arange(b)
-                if s == 1:
+                if self.kv_quant:
+                    # Read-modify-write requantization, one code path for
+                    # s == 1 and the speculative-verify span: gather the
+                    # touched window of blocks, dequantize, insert this
+                    # step's K/V, re-scale per block/head (absmax / 127,
+                    # the serve/quant.py grid), scatter codes + scales
+                    # back. Positions past the bound span and windows
+                    # landing on the null block are routed to the
+                    # out-of-range index kv_num_blocks, which the scatter
+                    # drops — the fp path's null-block masking, expressed
+                    # as OOB-drop so clamped duplicates can't corrupt a
+                    # row's real tail block.
+                    T = (s + 2 * kv_block_size - 2) // kv_block_size
+                    base = decode_pos // kv_block_size
+                    tb_log = base[:, None] + jnp.arange(T)  # [B, T]
+                    in_table = tb_log < max_blocks
+                    phys = jnp.where(
+                        in_table,
+                        block_tables[rows[:, None],
+                                     jnp.minimum(tb_log, max_blocks - 1)],
+                        0)  # [B, T]
+                    pos_mat = decode_pos[:, None] + jnp.arange(s)
+                    woff = jnp.where(
+                        pos_mat < span,
+                        pos_mat - base[:, None] * kv_block_size,
+                        T * kv_block_size)
+                    wpos = base[:, None] * kv_block_size + \
+                        jnp.arange(T * kv_block_size)
+                    live = wpos < jnp.minimum(decode_pos + s,
+                                              span)[:, None]
+                    tgt = jnp.where(in_table & (phys > 0), phys,
+                                    kv_num_blocks)
+
+                    def requant_write(cvar, svar, new):
+                        # new: [B, S, H, D] — this step's projections.
+                        vals = cvar.value[phys].astype(jnp.float32) * \
+                            svar.value[phys][..., None, None]
+                        win = vals.transpose(0, 1, 3, 2, 4).reshape(
+                            b, T * kv_block_size, self.num_heads,
+                            head_dim)
+                        win = win.at[rows[:, None], woff].set(
+                            new.astype(jnp.float32))
+                        # Zero everything above the row's live extent so
+                        # recycled-block garbage can't inflate the absmax
+                        # (the step bias hides it from attention either
+                        # way; this keeps the quantization grid tight).
+                        win = jnp.where(live[:, :, None, None], win, 0.0)
+                        blocks = win.reshape(
+                            b, T, kv_block_size, self.num_heads,
+                            head_dim).transpose(0, 1, 3, 2, 4)
+                        amax = jnp.max(jnp.abs(blocks), axis=(3, 4))
+                        scale = jnp.where(amax > 0.0, amax / 127.0, 1.0)
+                        codes = jnp.clip(
+                            jnp.rint(blocks / scale[..., None, None]),
+                            -127.0, 127.0).astype(jnp.int8)
+                        cvar.value = cvar.value.at[tgt].set(codes)
+                        svar.value = svar.value.at[tgt].set(
+                            scale.astype(jnp.float32))
+
+                    requant_write(ck, cks, k.transpose(0, 2, 1, 3))
+                    requant_write(cv, cvs, v.transpose(0, 2, 1, 3))
+                elif s == 1:
                     # Row b's single-position K/V land in its current block:
                     # pool[block_tables[b, pos // bs], :, pos % bs]. Rows
                     # whose table entry is unbound write into the null block
@@ -230,8 +324,14 @@ class MultiHeadAttention(nn.Module):
             # span == max_decode_len this is bit-identical to the dense
             # per-row cache (masked positions contribute exactly 0).
 
-            def gathered(c):
+            def gathered(c, sc=None):
                 g = c[block_tables]  # [B, MB, H, bs, D]
+                if sc is not None:
+                    # Dequant-in-gather: int8 codes widen only here, the
+                    # pool itself stays int8 in memory.
+                    g = (g.astype(jnp.float32) *
+                         sc[block_tables][..., None, None]) \
+                        .astype(self.dtype)
                 return g.transpose(0, 2, 1, 3, 4).reshape(
                     b, self.num_heads, span, head_dim)
 
@@ -247,9 +347,11 @@ class MultiHeadAttention(nn.Module):
                 step_bias = jnp.where(
                     jnp.arange(span)[None, None, :] <= pos_mat[:, :, None],
                     0.0, -1e30)[:, None, :, :].astype(jnp.float32)
-            out = fused_attention(q, gathered(ck.value),
-                                  gathered(cv.value), bias=step_bias,
-                                  causal=False, implementation="reference")
+            out = fused_attention(
+                q,
+                gathered(ck.value, None if cks is None else cks.value),
+                gathered(cv.value, None if cvs is None else cvs.value),
+                bias=step_bias, causal=False, implementation="reference")
         elif decode and self_attention:
             if max_decode_len <= 0:
                 raise ValueError("decode=True needs max_decode_len")
@@ -373,6 +475,7 @@ class TransformerLayer(nn.Module):
     moe_capacity_factor: float = 1.25
     moe_top_k: int = 2
     quantized: bool = False
+    kv_quant: str = ""
 
     @nn.compact
     def __call__(self, x, enc=None, self_bias=None, cross_bias=None,
@@ -384,7 +487,8 @@ class TransformerLayer(nn.Module):
             dtype=self.dtype, param_dtype=jnp.float32, name=name)
         attn = lambda name: MultiHeadAttention(
             self.num_heads, self.dtype, self.dropout_rate,
-            self.attention_impl, quantized=self.quantized, name=name)
+            self.attention_impl, quantized=self.quantized,
+            kv_quant=self.kv_quant, name=name)
 
         def residual(x, sub, name):
             if self.prenorm:

@@ -91,6 +91,7 @@ class TransformerNMT(nn.Module):
     dropout_rate: float = 0.0
     attention_impl: str = "auto"
     quantized: bool = False
+    kv_quant: str = ""
 
     def setup(self):
         self.embed = NmtEmbeddings(
@@ -99,7 +100,8 @@ class TransformerNMT(nn.Module):
         layer = lambda cross: TransformerLayer(
             self.num_heads, self.mlp_dim, self.dtype, self.dropout_rate,
             prenorm=True, cross_attention=cross,
-            attention_impl=self.attention_impl, quantized=self.quantized)
+            attention_impl=self.attention_impl, quantized=self.quantized,
+            kv_quant=self.kv_quant)
         self.enc = [layer(False) for _ in range(self.num_layers)]
         self.enc_norm = nn.LayerNorm(dtype=self.dtype,
                                      param_dtype=jnp.float32)

@@ -73,6 +73,54 @@ def _drain_trace(engine: Engine, trace, max_new_tokens: int,
     return ids, ticks
 
 
+def _kv_quant_divergence(model, variables, src_len: int, vocab_size: int,
+                         seed: int, steps: int = 8, block_size: int = 4):
+    """Bounded logits-divergence check for the int8 KV cache: the same
+    teacher-forced token sequence decoded step-by-step through the paged
+    path with fp32 blocks vs int8 blocks + per-block scales. Same relative
+    bound as :func:`_quant_divergence` — int8 KV is a bounded-divergence
+    knob exactly like weight-only ``--quantize``."""
+    import jax
+    import jax.numpy as jnp
+
+    from .quant import kv_quantized_model
+
+    rng = np.random.RandomState(seed + 2)
+    b = 2
+    src = rng.randint(3, vocab_size, size=(b, src_len)).astype(np.int32)
+    mask = np.ones((b, src_len), np.int32)
+    toks = rng.randint(3, vocab_size, size=(b, steps)).astype(np.int32)
+    max_blocks = -(-steps // block_size)
+    nb = b * max_blocks + 1  # + block 0, the null sentinel
+    tables = np.arange(1, nb).reshape(b, max_blocks).astype(np.int32)
+
+    def run(m):
+        mcls = type(m)
+        enc = m.apply(variables, src, mask, method=mcls.encode)
+        cache = m.init(jax.random.PRNGKey(0), toks[:, :1], enc, mask,
+                       np.zeros((b,), np.int32), tables,
+                       num_blocks=nb, block_size=block_size,
+                       method=mcls.decode_step_paged)["cache"]
+        outs = []
+        for t in range(steps):
+            logits, vs = m.apply(
+                {"params": variables["params"], "cache": cache},
+                toks[:, t:t + 1], enc, mask,
+                np.full((b,), t, np.int32), tables,
+                num_blocks=nb, block_size=block_size,
+                method=mcls.decode_step_paged, mutable=["cache"])
+            cache = vs["cache"]
+            outs.append(logits)
+        return jnp.concatenate(outs, axis=1)
+
+    ref = run(model)
+    q = run(kv_quantized_model(model))
+    diff = float(jnp.max(jnp.abs(q.astype(jnp.float32)
+                                 - ref.astype(jnp.float32))))
+    bound = 0.1 * max(1.0, float(jnp.max(jnp.abs(ref))))
+    return diff, bound, diff <= bound
+
+
 def _quant_divergence(model, fp32_variables, src_len: int,
                       vocab_size: int, seed: int):
     """Bounded logits-divergence check for int8 weight-only serving: one
@@ -102,7 +150,9 @@ def run_serve_bench(num_requests: int = 16, slots: int = 4,
                     decode_window: int = DEFAULT_DECODE_WINDOW,
                     kv_block_size: int = 16, kv_blocks: int = 0,
                     prefix_cache: int = 16, prefix_dup: float = 0.0,
-                    speculate: int = 0, quantize: str = "",
+                    speculate: int = 0, speculate_device: bool = False,
+                    draft: str = "self", quantize: str = "",
+                    kv_quant: str = "",
                     smoke: bool = False) -> Dict:
     """Run the fixed trace to drain; return the BENCH-contract record.
 
@@ -112,14 +162,25 @@ def run_serve_bench(num_requests: int = 16, slots: int = 4,
     self-draft speculative decoding and re-runs the same trace through a
     plain-greedy reference engine to assert the token-identical contract
     (``token_identical`` in the record — the t1 gate fails the build on a
-    parity break). ``quantize="int8"`` serves weight-only int8 and reports
-    the weight/KV HBM footprint next to fp32 plus a bounded
-    logits-divergence check.
+    parity break). ``speculate_device=True`` chains γ-windows on device
+    (engine ``--speculate-device``) and additionally runs the host accept
+    loop over the same trace so the record carries both paths' measured
+    host syncs per emitted token (``host_syncs_per_token`` vs
+    ``host_syncs_per_token_host_path`` — the number the chain exists to
+    shrink). ``draft="tiny-distilled"`` swaps the self-draft (total
+    acceptance by construction — a ceiling, not a measurement) for the
+    committed distilled draft so ``spec_accept_rate`` is a real measured
+    rate. ``quantize="int8"`` serves weight-only int8 and reports the
+    weight/KV HBM footprint next to fp32 plus a bounded logits-divergence
+    check; ``kv_quant="int8"`` stores the paged KV pool as int8 codes +
+    per-block scales (same bounded-divergence contract, reported as
+    ``kv_divergence*`` with ``kv_cache_bytes`` vs ``kv_cache_bytes_fp32``)
+    and composes with both of the above.
     """
     import jax
 
     from ..models.transformer_nmt import transformer_nmt_tiny
-    from .quant import variables_bytes
+    from .quant import kv_pool_bytes, variables_bytes
 
     if smoke:
         num_requests, slots = min(num_requests, 4), min(slots, 2)
@@ -136,9 +197,17 @@ def run_serve_bench(num_requests: int = 16, slots: int = 4,
         default_max_new_tokens=max_new_tokens,
         decode_window=decode_window, kv_block_size=kv_block_size,
         kv_blocks=kv_blocks, prefix_cache_size=prefix_cache,
-        quantize=quantize)
-    engine = Engine(model, fp32_variables,
-                    speculate_gamma=speculate, **engine_kwargs)
+        quantize=quantize, kv_quant=kv_quant)
+    draft_model = draft_variables = None
+    if draft and draft != "self":
+        from .loader import distilled_draft
+
+        draft_model, draft_variables = distilled_draft(draft)
+    spec_kwargs = dict(speculate_gamma=speculate,
+                       speculate_device=speculate_device,
+                       draft_model=draft_model,
+                       draft_variables=draft_variables)
+    engine = Engine(model, fp32_variables, **spec_kwargs, **engine_kwargs)
     trace = _fixed_trace(num_requests, src_len, 96, seed=seed,
                          prefix_dup=prefix_dup)
     # Warmup outside the timed window: compiles the encoder, the fused
@@ -165,9 +234,26 @@ def run_serve_bench(num_requests: int = 16, slots: int = 4,
             engine.poll(i).tokens == ref.poll(ri).tokens
             for i, ri in zip(ids, ref_ids))
 
+    # With the device-resident chain on, also run the host accept loop
+    # over the identical trace: the record then carries both paths'
+    # measured host syncs per emitted token, which is the SPEC_DEVICE
+    # gate's strictly-below comparison.
+    host_path_syncs = None
+    if speculate > 0 and speculate_device and beam_size == 1:
+        host_eng = Engine(model, fp32_variables, speculate_gamma=speculate,
+                          draft_model=draft_model,
+                          draft_variables=draft_variables, **engine_kwargs)
+        _drain_trace(host_eng, trace, max_new_tokens, beam_size)
+        host_path_syncs = host_eng.metrics.spec_host_syncs_per_token
+
     divergence = bound = divergence_ok = None
     if quantize:
         divergence, bound, divergence_ok = _quant_divergence(
+            model, fp32_variables, src_len, 96, seed)
+
+    kv_divergence = kv_bound = kv_divergence_ok = None
+    if kv_quant:
+        kv_divergence, kv_bound, kv_divergence_ok = _kv_quant_divergence(
             model, fp32_variables, src_len, 96, seed)
 
     lat = [engine.poll(i).latency_s for i in ids
@@ -176,6 +262,11 @@ def run_serve_bench(num_requests: int = 16, slots: int = 4,
     toks = m.tokens_generated - warmup_tokens  # minus the warmup request
     kv_bytes = int(sum(np.asarray(leaf).nbytes for leaf in
                        jax.tree_util.tree_leaves(engine.cache)))
+    kv_cache_bytes = kv_cache_bytes_fp32 = None
+    if engine.kv_blocks:
+        kv_cache_bytes, kv_cache_bytes_fp32 = kv_pool_bytes(
+            engine.cache, engine.kv_blocks)
+    snap = m.snapshot()
     return {
         "metric": METRIC,
         "value": round(toks / elapsed, 2) if elapsed > 0 else None,
@@ -215,6 +306,14 @@ def run_serve_bench(num_requests: int = 16, slots: int = 4,
         if m.spec_tokens_per_target_step is None
         else round(m.spec_tokens_per_target_step, 4),
         "token_identical": token_identical,
+        "speculate_device": speculate_device,
+        "draft": draft,
+        "spec_chain_len_p50": snap.get("serve_spec_chain_len_p50"),
+        "host_syncs_per_token": None
+        if m.spec_host_syncs_per_token is None
+        else round(m.spec_host_syncs_per_token, 4),
+        "host_syncs_per_token_host_path": None if host_path_syncs is None
+        else round(host_path_syncs, 4),
         "quantize": quantize,
         "weight_bytes": variables_bytes(engine.variables),
         "weight_bytes_fp32": variables_bytes(fp32_variables),
@@ -223,5 +322,13 @@ def run_serve_bench(num_requests: int = 16, slots: int = 4,
         else round(divergence, 6),
         "divergence_bound": None if bound is None else round(bound, 6),
         "divergence_ok": divergence_ok,
+        "kv_quant": kv_quant,
+        "kv_cache_bytes": kv_cache_bytes,
+        "kv_cache_bytes_fp32": kv_cache_bytes_fp32,
+        "kv_divergence": None if kv_divergence is None
+        else round(kv_divergence, 6),
+        "kv_divergence_bound": None if kv_bound is None
+        else round(kv_bound, 6),
+        "kv_divergence_ok": kv_divergence_ok,
         "device": jax.default_backend(),
     }

@@ -30,6 +30,20 @@ from typing import Dict, List
 from .queue import OverloadError
 
 
+def is_pool_leaf(leaf, num_blocks: int) -> bool:
+    """True for cache-tree leaves that are indexed by pool block id: the
+    4-D [num_blocks, H, block_size, D] K/V pools themselves AND (with
+    ``--kv-quant``) their 2-D [num_blocks, H] per-block scale sidecars.
+    Every block-id-keyed operation — beam copy-on-write forks, handoff
+    export/import — must move both together, or a forked/imported block's
+    codes land under the wrong scale."""
+    nd = getattr(leaf, "ndim", 0)
+    if nd not in (2, 4):
+        return False
+    shape = getattr(leaf, "shape", ())
+    return bool(shape) and shape[0] == num_blocks
+
+
 class BlockPoolExhausted(OverloadError):
     """The KV block pool cannot cover a reservation or allocation.
 

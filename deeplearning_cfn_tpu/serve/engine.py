@@ -91,7 +91,7 @@ import numpy as np
 
 from ..models.decoding import BOS_ID, EOS_ID, PAD_ID
 from ..obs.trace import span
-from .blockpool import BlockAllocator
+from .blockpool import BlockAllocator, is_pool_leaf
 from .metrics import ServeMetrics
 from .prefix import PrefixCache
 from .queue import OverloadError, Request, RequestQueue, RequestState
@@ -149,9 +149,11 @@ class Engine:
                  kv_blocks: int = 0,
                  prefix_cache_size: int = 0,
                  speculate_gamma: int = 0,
+                 speculate_device: bool = False,
                  draft_model=None,
                  draft_variables=None,
                  quantize: str = "",
+                 kv_quant: str = "",
                  phase: str = "both",
                  clock=time.monotonic,
                  metrics: Optional[ServeMetrics] = None,
@@ -165,6 +167,11 @@ class Engine:
         if speculate_gamma < 0:
             raise ValueError(
                 f"speculate_gamma must be >= 0, got {speculate_gamma}")
+        if speculate_device and speculate_gamma <= 0:
+            raise ValueError(
+                "speculate_device requires speculate_gamma > 0 — there "
+                "is no speculative loop to move on-device")
+        self.speculate_device = bool(speculate_device)
         # Disaggregated serving phase. "both" (default) is the co-located
         # engine, behavior-identical to before the split. "prefill" runs
         # admission prefill + exactly ONE decode step per request, then
@@ -195,6 +202,22 @@ class Engine:
                 if draft_variables is not None:
                     draft_variables = quantize_variables(
                         draft_variables, self.quantize)
+        # Int8 KV-cache quantization — like --quantize, the engine owns
+        # the model clone, so the paged decoder allocates int8 pools plus
+        # per-block scale sidecars. Paged-only by construction: the
+        # per-block absmax grid IS the block structure. The draft model
+        # is deliberately left unquantized — its cache is the small dense
+        # row table, where int8 buys nothing.
+        self.kv_quant = str(kv_quant or "")
+        if self.kv_quant:
+            if int(kv_block_size) <= 0:
+                raise ValueError(
+                    "kv_quant requires the paged KV path "
+                    "(kv_block_size > 0) — the quantization grid is per "
+                    "pool block")
+            from .quant import kv_quantized_model
+
+            model = kv_quantized_model(model, self.kv_quant)
         self.model = model
         self.variables = variables
         self.capacity = capacity
@@ -255,10 +278,12 @@ class Engine:
                     f"draft vocab_size {draft_vocab} != target's "
                     f"{tgt_vocab} — proposals would not be comparable")
             self.metrics.configure_speculation(self.speculate_gamma)
+            self.metrics.configure_spec_chain(self.speculate_device)
         else:
             self.draft_model = None
             self.draft_variables = None
         self._spec_fn_cached = None
+        self._spec_chain_fns: Dict[int, Callable] = {}
 
         # Paged-KV configuration. The divisibility requirement is what
         # makes the paged step bit-identical to the dense one: the gathered
@@ -336,11 +361,12 @@ class Engine:
                 # padded pair list (padding pairs are (0, 0) — a null-
                 # block self-copy no-op). Gathers read the pre-update
                 # pool, so a block freed+reused within one tick still
-                # copies its old content.
+                # copies its old content. is_pool_leaf covers the int8
+                # scale sidecars too — a forked tail block must carry its
+                # quantization scale or its codes decode wrong.
                 return jax.tree_util.tree_map(
                     lambda c: c.at[dst].set(c[src])
-                    if getattr(c, "ndim", 0) == 4 and c.shape[0] == nb
-                    else c, cache)
+                    if is_pool_leaf(c, nb) else c, cache)
 
             self._copy_blocks_fn = jax.jit(_copy_blocks,
                                            donate_argnums=(0,))
@@ -387,6 +413,11 @@ class Engine:
                 jax.random.PRNGKey(0), jnp.zeros((cap, 1), jnp.int32),
                 self._enc, self._src_mask, jnp.zeros((cap,), jnp.int32),
                 method=mcls.decode_step_at)["cache"]
+        if self.kv_quant:
+            from .quant import kv_pool_bytes
+
+            stored, _ = kv_pool_bytes(self.cache, self.kv_blocks)
+            self.metrics.configure_kv_quant(stored)
         # Host-side per-row state (scheduler-authoritative; uploaded into
         # each device call and refreshed from its outputs).
         self._prev = np.full((cap,), PAD_ID, np.int32)
@@ -1111,7 +1142,190 @@ class Engine:
         self.metrics.record_spec(
             proposed=gamma * rows_active, accepted=accepted_total,
             target_row_steps=rows_active, emitted=new_tokens, rates=rates)
+        # The host path pays one device→host sync per γ window — recorded
+        # through the same counters as the device-resident chain so
+        # host_syncs_per_token is directly comparable across paths.
+        self.metrics.record_spec_chain(windows=1, syncs=1,
+                                       emitted=new_tokens)
         return 1
+
+    # -- the device-resident speculative chain -----------------------------
+
+    def _spec_chain_fn(self, chain: int):
+        """Jitted CHAIN of speculative windows: ``lax.scan`` over
+        ``chain`` draft-propose → target-verify → accept-advance windows,
+        with the accept-prefix rule AND the EOS/budget/exhaustion
+        truncation running on device (exactly the fused window's scan-
+        body rules). One device call advances up to ``chain * (γ+1)``
+        positions; the only host traffic afterwards is the stacked
+        [chain, capacity, γ+1] target ids plus the [chain, capacity]
+        accept-count vectors — :meth:`_spec_chain_step` replays emission
+        from those post-hoc, so the device carry (prev/pos/steps_left/
+        active) and the host mirrors advance by construction under the
+        SAME rules and the output stays token-identical to the host
+        :meth:`_spec_step` path and to plain greedy."""
+        fn = self._spec_chain_fns.get(chain)
+        if fn is not None:
+            return fn
+        model, mcls = self.model, type(self.model)
+        dmodel, dmcls = self.draft_model, type(self.draft_model)
+        gamma = self.speculate_gamma
+        max_len = self.model_max_len
+        nb, bs = self.kv_blocks, self.kv_block_size
+        paged = self.paged
+
+        def draft_scan(vd, dcache, prev, pos, active, enc_d, src_mask):
+            # Identical to _spec_fn's draft scan (γ+1 steps; see there
+            # for why the extra step and what overwrites the correction).
+            def body(carry, _):
+                dcache, dprev, dpos = carry
+                nxt, mut = dmodel.apply(
+                    {**vd, "cache": dcache}, dprev[:, None], enc_d,
+                    src_mask, dpos, method=dmcls.greedy_step_at,
+                    mutable=["cache"])
+                dcache = mut["cache"]
+                dprev = jnp.where(active, nxt, PAD_ID)
+                dpos = jnp.minimum(dpos + active.astype(jnp.int32),
+                                   max_len - 1)
+                return (dcache, dprev, dpos), dprev
+
+            (dcache, _, _), drafts = jax.lax.scan(
+                body, (dcache, prev, pos), None, length=gamma + 1)
+            return dcache, drafts[:gamma].T
+
+        def chain_fn(v, vd, cache, dcache, prev, pos, steps_left, active,
+                     enc, src_mask, enc_d, *tables):
+            def body(carry, _):
+                cache, dcache, prev, pos, steps_left, active = carry
+                dcache, props = draft_scan(vd, dcache, prev, pos, active,
+                                           enc_d, src_mask)
+                tgt_in = jnp.concatenate([prev[:, None], props], axis=1)
+                if paged:
+                    logits, mut = model.apply(
+                        {**v, "cache": cache}, tgt_in, enc, src_mask,
+                        pos, tables[0], num_blocks=nb, block_size=bs,
+                        method=mcls.decode_span_paged, mutable=["cache"])
+                else:
+                    logits, mut = model.apply(
+                        {**v, "cache": cache}, tgt_in, enc, src_mask,
+                        pos, method=mcls.decode_span_at,
+                        mutable=["cache"])
+                cache = mut["cache"]
+                tgt = jnp.argmax(logits.astype(jnp.float32),
+                                 axis=-1).astype(jnp.int32)
+                # Accept-prefix length per row: the cumprod trick turns
+                # the first draft/target disagreement into a hard stop.
+                eq = (props == tgt[:, :gamma]).astype(jnp.int32)
+                acc = jnp.cumprod(eq, axis=1).sum(axis=1)
+                # Emit positions j = 0..acc, token-by-token with the
+                # fused window's termination rules — unrolled (γ+1 is
+                # small and static), so a mid-window EOS truncates the
+                # rest of the window AND deactivates the row for every
+                # later window in the chain.
+                for j in range(gamma + 1):
+                    can = active & (j <= acc)
+                    tok = jnp.where(can, tgt[:, j], PAD_ID)
+                    steps_left = steps_left - can.astype(jnp.int32)
+                    new_pos = pos + can.astype(jnp.int32)
+                    done_now = can & ((tok == EOS_ID) | (steps_left <= 0)
+                                      | (new_pos >= max_len))
+                    active = active & ~done_now
+                    prev = jnp.where(can, tok, prev)
+                    pos = jnp.minimum(new_pos, max_len - 1)
+                return (cache, dcache, prev, pos, steps_left, active), \
+                    (tgt, acc)
+
+            carry = (cache, dcache, prev, pos, steps_left, active)
+            carry, (tgts, accs) = jax.lax.scan(body, carry, None,
+                                               length=chain)
+            return tgts, accs, carry[0], carry[1]
+
+        fn = jax.jit(chain_fn, donate_argnums=(2, 3))
+        self._spec_chain_fns[chain] = fn
+        return fn
+
+    def _spec_chain_step(self, chain: int) -> int:
+        """One device-resident speculative tick: run ``chain`` γ windows
+        in ONE device call, then replay the device-computed accept counts
+        into host bookkeeping. The replay applies the same EOS/budget/
+        exhaustion rules the device carry applied, so the host mirrors
+        land exactly where the device left prev/pos — the property the
+        parity grid pins."""
+        cap = self.capacity
+        gamma = self.speculate_gamma
+        active = np.zeros((cap,), bool)
+        steps_left = np.zeros((cap,), np.int32)
+        for g in self._groups:
+            r = g.rows[0]
+            active[r] = True
+            steps_left[r] = g.budget - g.steps
+        if self.paged:
+            # Worst case the whole chain fully accepts: bind blocks for a
+            # chain*(γ+1)-token advance, clamped to each row's budget by
+            # _bind_rows (overflow writes land in the null block).
+            self._bind_rows(chain * (gamma + 1))
+        kv_in_use = self.allocator.blocks_in_use if self.paged else None
+        t0 = self._clock()
+        args = (self.variables, self.draft_variables, self.cache,
+                self._draft_cache, jnp.asarray(self._prev),
+                jnp.asarray(self._pos), jnp.asarray(steps_left),
+                jnp.asarray(active), self._enc, self._src_mask,
+                self._enc if self._enc_d is None else self._enc_d)
+        if self.paged:
+            args += (jnp.asarray(self._block_tables),)
+        tgts, accs, self.cache, self._draft_cache = \
+            self._spec_chain_fn(chain)(*args)
+        # THE host sync of the whole chain — [chain, capacity, γ+1]
+        # target ids + [chain, capacity] accept counts, nothing else.
+        tgts = np.asarray(tgts)
+        accs = np.asarray(accs)
+        dt = self._clock() - t0
+        self.queue.note_decode_window(dt)
+        now = self._clock()
+        new_tokens = 0
+        active_row_steps = 0
+        proposed = 0
+        accepted_total = 0
+        rates: List[float] = []
+        for g in list(self._groups):
+            r = g.rows[0]
+            done = False
+            for w in range(chain):
+                a = int(accs[w, r])
+                active_row_steps += 1
+                proposed += gamma
+                accepted_total += a
+                rates.append(a / gamma)
+                for j in range(a + 1):
+                    tok = int(tgts[w, r, j])
+                    g.req.tokens.append(tok)
+                    g.steps += 1
+                    g.decoded += 1
+                    new_tokens += 1
+                    if g.req.first_token_at is None:
+                        g.req.first_token_at = now
+                        self.metrics.record_first_token(g.req.ttft_s)
+                    new_pos = int(self._pos[r]) + 1
+                    exhausted = new_pos >= self.model_max_len
+                    self._pos[r] = min(new_pos, self.model_max_len - 1)
+                    self._prev[r] = tok
+                    if tok == EOS_ID or g.steps >= g.budget or exhausted:
+                        done = True
+                        break
+                if done:
+                    break
+            if done:
+                self._release(g, RequestState.DONE, now)
+        self.metrics.record_step(
+            active_row_steps, self.queue.depth, new_tokens, dt,
+            steps=chain, kv_blocks_in_use=kv_in_use)
+        self.metrics.record_spec(
+            proposed=proposed, accepted=accepted_total,
+            target_row_steps=active_row_steps, emitted=new_tokens,
+            rates=rates)
+        self.metrics.record_spec_chain(windows=chain, syncs=1,
+                                       emitted=new_tokens)
+        return chain
 
     # -- the step ----------------------------------------------------------
 
@@ -1152,9 +1366,19 @@ class Engine:
         elif self.speculate_gamma > 0 and self.phase != "prefill" \
                 and not any(g.req.deadline is not None
                             for g in self._groups):
-            with span("serve.decode", path="spec",
-                      k=self.speculate_gamma, request_ids=active_ids):
-                n = self._spec_step()
+            if self.speculate_device:
+                # Device-resident accept/advance: chain as many γ windows
+                # per device call as the window planner allows (the same
+                # gating as --decode-window: drop to 1 under queue
+                # pressure with a free row so admission stays fresh).
+                k = self._plan_window()
+                with span("serve.decode", path="spec-device", k=k,
+                          request_ids=active_ids):
+                    n = self._spec_chain_step(k)
+            else:
+                with span("serve.decode", path="spec",
+                          k=self.speculate_gamma, request_ids=active_ids):
+                    n = self._spec_step()
         else:
             k = self._plan_window()
             with span("serve.decode", path="fused", k=k,
@@ -1325,8 +1549,7 @@ class Engine:
     # -- KV handoff (disaggregated prefill/decode) -------------------------
 
     def _pool_leaf_p(self, leaf) -> bool:
-        return getattr(leaf, "ndim", 0) == 4 and \
-            leaf.shape[0] == self.kv_blocks
+        return is_pool_leaf(leaf, self.kv_blocks)
 
     def export_handoff(self, request_id: str) -> Dict[str, np.ndarray]:
         """Serialize a parked request's resume state (see
@@ -1414,6 +1637,17 @@ class Engine:
                 raise ValueError(
                     f"handoff artifact {key}={meta[key]} does not match "
                     f"this engine's {mine}")
+        # KV precision must match before any state is committed: an int8
+        # exporter ships scale sidecars as extra kv_* leaves, so a
+        # cross-precision pair disagrees on the leaf count (and an fp
+        # payload scattered into int8 pools would silently misdecode).
+        n_mine = sum(1 for leaf in jax.tree_util.tree_leaves(self.cache)
+                     if self._pool_leaf_p(leaf))
+        if n_mine != kv_leaf_count(artifact):
+            raise ValueError(
+                f"handoff artifact carries {kv_leaf_count(artifact)} KV "
+                f"leaves, this engine's pool has {n_mine} — the pair "
+                f"must agree on --kv-quant")
         w, steps, budget = meta["width"], meta["steps"], meta["budget"]
         free = self._free_rows()
         peak = self._peak_blocks(w, budget)
@@ -1538,20 +1772,42 @@ class Engine:
             return
         if steps <= 0:
             return
-        bs = self.kv_block_size
         rbi = np.asarray(artifact["row_block_index"], np.int32)
+        # Pair 4-D code leaves with their 2-D scale sidecars (an int8
+        # exporter interleaves them in tree order); the draft's dense
+        # fp cache is warmed from the DEQUANTIZED blocks, so self-draft
+        # acceptance stays total against the int8 target pool.
+        from .handoff import kv_leaf_count as _klc
+        from .quant import dequantize_kv_blocks
+
+        art = [np.asarray(artifact[f"kv_{i}"])
+               for i in range(_klc(artifact))]
+        pairs = []
+        i = 0
+        while i < len(art):
+            if art[i].ndim == 4 and i + 1 < len(art) \
+                    and art[i + 1].ndim == 2:
+                pairs.append((art[i], art[i + 1]))
+                i += 2
+            else:
+                pairs.append((art[i], None))
+                i += 1
         dleaves, dtreedef = jax.tree_util.tree_flatten(self._draft_cache)
         li = 0
         out = []
         for dleaf in dleaves:
             if getattr(dleaf, "ndim", 0) == 4 \
                     and dleaf.shape[0] == self.capacity:
-                payload = np.asarray(artifact[f"kv_{li}"])
+                payload, scales = pairs[li]
                 for j, r in enumerate(rows):
                     idxs = [int(i) for i in rbi[j] if i >= 0]
+                    blocks = payload[idxs]  # [nb_j, H, bs, D]
+                    if scales is not None:
+                        blocks = dequantize_kv_blocks(blocks,
+                                                      scales[idxs])
                     # [nb_j, H, bs, D] -> [H, nb_j*bs, D], cut to steps.
                     dense = np.concatenate(
-                        [payload[i] for i in idxs], axis=1)[:, :steps, :]
+                        list(blocks), axis=1)[:, :steps, :]
                     dleaf = dleaf.at[r, :, :steps, :].set(
                         jnp.asarray(dense).astype(dleaf.dtype))
                 li += 1

@@ -10,8 +10,9 @@ may arrive as text; without one they arrive as raw token ids.
 
 from __future__ import annotations
 
+import os
 import time
-from typing import Optional, Tuple
+from typing import Optional, Tuple, Union
 
 import jax
 
@@ -19,6 +20,41 @@ from ..ckpt import CheckpointManager, latest_checkpoint, \
     retry_policy_from_config
 from ..config import ExperimentConfig, MeshConfig
 from .engine import Engine
+
+# Committed distilled-draft checkpoints for speculative serving, keyed by
+# the ``draft_cfg`` preset string. Each maps to (model kwargs, npz file):
+# the model must share the target's vocab and cover its max_len (the
+# engine validates), and the npz is a flat {"a/b/c": array} params tree
+# produced by tools/distill_draft.py. "tiny-distilled" is the shrunk
+# draft distilled against the exact random-init tiny teacher
+# `bench --serve` builds, so the serving bench measures a REAL accept
+# rate instead of the self-draft total-acceptance ceiling.
+DRAFT_PRESETS = {
+    "tiny-distilled": (
+        dict(vocab_size=96, max_len=64, hidden_size=32, num_layers=1,
+             num_heads=2, mlp_dim=64),
+        "draft_tiny_distilled.npz"),
+}
+
+
+def distilled_draft(name: str = "tiny-distilled"):
+    """Load a committed distilled draft → ``(model, variables)`` for the
+    engine's ``draft_model``/``draft_variables`` kwargs."""
+    import numpy as np
+    from flax import traverse_util
+
+    from ..models.transformer_nmt import transformer_nmt_tiny
+
+    if name not in DRAFT_PRESETS:
+        raise ValueError(
+            f"unknown draft preset {name!r}; have {sorted(DRAFT_PRESETS)}")
+    kwargs, fname = DRAFT_PRESETS[name]
+    model = transformer_nmt_tiny(**kwargs)
+    path = os.path.join(os.path.dirname(__file__), "data", fname)
+    with np.load(path) as z:
+        flat = {tuple(k.split("/")): z[k] for k in z.files}
+    params = traverse_util.unflatten_dict(flat)
+    return model, {"params": params}
 
 
 def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
@@ -29,8 +65,10 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
                 kv_block_size: int = 0, kv_blocks: int = 0,
                 prefix_cache_size: int = 0,
                 speculate_gamma: int = 0,
-                draft_cfg: Optional[ExperimentConfig] = None,
+                speculate_device: bool = False,
+                draft_cfg: Union[ExperimentConfig, str, None] = None,
                 quantize: str = "",
+                kv_quant: str = "",
                 phase: str = "both",
                 step: int = 0, vocab: str = "", allow_init: bool = False,
                 clock=time.monotonic) -> Tuple[Engine, object, int]:
@@ -43,10 +81,15 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
     ``speculate_gamma > 0`` turns on speculative decoding. With
     ``draft_cfg`` (a second, shrunk experiment sharing the target's vocab)
     the draft checkpoint is restored through the same retry-wrapped path;
-    without it the engine self-drafts — exact but speedup-free, the
-    smoke/parity configuration. ``quantize="int8"`` hands the engine
-    weight-only int8 serving: the fp32 restore stays canonical and the
-    engine quantizes (and re-quantizes on every ``swap_variables``).
+    a :data:`DRAFT_PRESETS` string (e.g. ``"tiny-distilled"``) loads a
+    committed distilled draft instead; without either the engine
+    self-drafts — exact but speedup-free, the smoke/parity configuration.
+    ``speculate_device=True`` selects the device-resident accept/advance
+    chain (engine ``--speculate-device``). ``quantize="int8"`` hands the
+    engine weight-only int8 serving: the fp32 restore stays canonical and
+    the engine quantizes (and re-quantizes on every ``swap_variables``).
+    ``kv_quant="int8"`` stores the paged KV pool as int8 codes with
+    per-block scales (requires ``kv_block_size > 0``).
     """
     from ..train.run import _workdir_and_ckpt_dir
     from ..train.task import Seq2SeqTask, build_task
@@ -83,7 +126,11 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
 
         bpe = Bpe.load(vocab)
     draft_model = draft_variables = None
-    if draft_cfg is not None:
+    if isinstance(draft_cfg, str):
+        if speculate_gamma <= 0:
+            raise ValueError("draft_cfg given but speculate_gamma is 0")
+        draft_model, draft_variables = distilled_draft(draft_cfg)
+    elif draft_cfg is not None:
         if speculate_gamma <= 0:
             raise ValueError("draft_cfg given but speculate_gamma is 0")
         draft_cfg.mesh = MeshConfig(data=-1)
@@ -120,8 +167,10 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
         kv_block_size=kv_block_size, kv_blocks=kv_blocks,
         prefix_cache_size=prefix_cache_size,
         speculate_gamma=speculate_gamma,
+        speculate_device=speculate_device,
         draft_model=draft_model, draft_variables=draft_variables,
         quantize=quantize,
+        kv_quant=kv_quant,
         phase=phase,
         clock=clock)
     engine.metrics.ckpt_load_retries = manager.store_retries()

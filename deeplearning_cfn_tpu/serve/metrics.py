@@ -93,6 +93,12 @@ class ServeMetrics:
         self._spec_emitted = None
         self._spec_target_steps = None
         self._spec_accept_rate = None
+        self._spec_device = False
+        self._spec_chain_windows = None
+        self._spec_chain_syncs = None
+        self._spec_chain_emitted = None
+        self._spec_chain_len = None
+        self._kv_quant_bytes = None
         self._goodput = None
         self._waste = None
         self._phase_prefill = None
@@ -138,6 +144,47 @@ class ServeMetrics:
         self._spec_accept_rate = r.histogram(
             "serve_spec_accept_rate",
             "per-row accepted/proposed fraction per spec call")
+
+    def configure_spec_chain(self, device: bool) -> None:
+        """Enable the speculative-chain sync accounting
+        (serve_spec_chain_*): windows per device call and host syncs per
+        emitted token. Recorded by BOTH the host `_spec_step` path
+        (always 1 window per sync) and the device-resident chain, so the
+        two paths' sync cost is directly comparable."""
+        r = self.registry
+        self._spec_device = bool(device)
+        self._spec_chain_windows = r.counter(
+            "serve_spec_chain_windows_total",
+            "speculative gamma-windows executed")
+        self._spec_chain_syncs = r.counter(
+            "serve_spec_chain_syncs_total",
+            "device->host syncs paid by speculative calls")
+        self._spec_chain_emitted = r.counter(
+            "serve_spec_chain_emitted_total",
+            "tokens emitted across speculative chains")
+        self._spec_chain_len = r.histogram(
+            "serve_spec_chain_len",
+            "gamma-windows chained per speculative device call")
+
+    def configure_kv_quant(self, pool_bytes: int) -> None:
+        """Enable the int8 KV-cache gauge (serve_kv_quant_bytes): the
+        block pool's as-stored footprint, codes plus scale sidecars."""
+        self._kv_quant_bytes = self.registry.gauge(
+            "serve_kv_quant_bytes",
+            "quantized KV pool bytes as stored (codes + scales)")
+        self._kv_quant_bytes.set(int(pool_bytes))
+
+    def record_spec_chain(self, windows: int, syncs: int,
+                          emitted: int) -> None:
+        """One speculative device call: how many γ windows it chained,
+        how many host syncs it cost (1 for both paths today — the point
+        is windows/sync), and the tokens it emitted."""
+        if self._spec_chain_windows is None:
+            return
+        self._spec_chain_windows.inc(windows)
+        self._spec_chain_syncs.inc(syncs)
+        self._spec_chain_emitted.inc(emitted)
+        self._spec_chain_len.observe(float(windows))
 
     def configure_request_ledger(self) -> None:
         """Enable the per-request phase ledger + goodput surface
@@ -468,6 +515,28 @@ class ServeMetrics:
         return self._spec_emitted.value() / steps
 
     @property
+    def spec_windows_per_chain(self) -> Optional[float]:
+        """Mean γ-windows per speculative device call (per host sync)."""
+        if self._spec_chain_syncs is None:
+            return None
+        syncs = self._spec_chain_syncs.value()
+        if syncs == 0:
+            return None
+        return self._spec_chain_windows.value() / syncs
+
+    @property
+    def spec_host_syncs_per_token(self) -> Optional[float]:
+        """Host syncs paid per emitted token — the number the
+        device-resident chain exists to shrink (the host path pays
+        1/(accepted+1) per token; a chain divides that by its length)."""
+        if self._spec_chain_syncs is None:
+            return None
+        emitted = self._spec_chain_emitted.value()
+        if emitted == 0:
+            return None
+        return self._spec_chain_syncs.value() / emitted
+
+    @property
     def goodput_tokens(self) -> int:
         if self._goodput is None:
             return 0
@@ -545,6 +614,23 @@ class ServeMetrics:
                 self._spec_accept_rate.percentile(95)
             snap["serve_spec_tokens_per_target_step"] = \
                 self.spec_tokens_per_target_step
+        if self._spec_chain_windows is not None:
+            snap["serve_spec_device"] = self._spec_device
+            snap["serve_spec_chain_windows"] = \
+                int(self._spec_chain_windows.value())
+            snap["serve_spec_chain_syncs"] = \
+                int(self._spec_chain_syncs.value())
+            snap["serve_spec_windows_per_chain"] = \
+                self.spec_windows_per_chain
+            snap["serve_spec_host_syncs_per_token"] = \
+                self.spec_host_syncs_per_token
+            snap["serve_spec_chain_len_p50"] = \
+                self._spec_chain_len.percentile(50)
+            snap["serve_spec_chain_len_p95"] = \
+                self._spec_chain_len.percentile(95)
+        if self._kv_quant_bytes is not None:
+            snap["serve_kv_quant_bytes"] = \
+                int(self._kv_quant_bytes.value())
         if self._goodput is not None:
             snap["serve_goodput_tokens"] = self.goodput_tokens
             snap["serve_wasted_tokens"] = self.wasted_tokens

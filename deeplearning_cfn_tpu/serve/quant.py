@@ -29,6 +29,10 @@ import numpy as np
 
 QUANT_DTYPES = ("int8",)
 
+# KV-cache quantization dtypes (``--kv-quant``). Separate from the weight
+# list because the two knobs compose but gate independently.
+KV_QUANT_DTYPES = ("int8",)
+
 # Symmetric int8 code range. +-127 (not -128) keeps the grid symmetric so
 # scale * code is an odd function of the weight — no zero-point needed.
 _QMAX = 127.0
@@ -100,3 +104,52 @@ def quantized_model(model):
             f"{type(model).__name__} has no 'quantized' field — int8 "
             "serving needs the shared transformer blocks")
     return model.clone(quantized=True)
+
+
+def kv_quantized_model(model, dtype: str = "int8"):
+    """Clone a Flax module with ``kv_quant`` set so its paged decoder
+    self-attention stores the shared block pool as int8 codes plus a
+    per-block/per-head float32 scale array (absmax-symmetric, same
+    ``_QMAX`` grid as the weight path). Dequantization happens in the
+    block-table gather, so the int8 pool is what lives in memory."""
+    if dtype not in KV_QUANT_DTYPES:
+        raise ValueError(
+            f"unsupported KV quantization dtype {dtype!r} "
+            f"(supported: {', '.join(KV_QUANT_DTYPES)})")
+    if not hasattr(model, "kv_quant"):
+        raise ValueError(
+            f"{type(model).__name__} has no 'kv_quant' field — int8 KV "
+            "serving needs the shared transformer blocks")
+    return model.clone(kv_quant=dtype)
+
+
+def dequantize_kv_blocks(codes: np.ndarray,
+                         scales: np.ndarray) -> np.ndarray:
+    """Host-side dequant of gathered pool blocks: ``codes``
+    [..., H, block, D] int8 times ``scales`` [..., H] broadcast back to
+    float32 — the inverse of the on-device per-block absmax write path
+    (used by the draft-cache warm on handoff import and by tests)."""
+    codes = np.asarray(codes)
+    scales = np.asarray(scales, np.float32)
+    return codes.astype(np.float32) * scales[..., :, None, None]
+
+
+def kv_pool_bytes(cache, num_blocks: int) -> tuple[int, int]:
+    """(bytes as stored, fp32-equivalent bytes) over the shared block-pool
+    leaves of a paged engine cache — the pair the bench reports as
+    ``kv_cache_bytes`` / ``kv_cache_bytes_fp32``. Scale arrays count into
+    the stored bytes (they are part of the footprint) but not into the
+    fp32 equivalent, which is the plain-pool baseline."""
+    import jax
+
+    from .blockpool import is_pool_leaf
+
+    stored = fp32 = 0
+    for leaf in jax.tree_util.tree_leaves(cache):
+        if not is_pool_leaf(leaf, num_blocks):
+            continue
+        arr = np.asarray(leaf)
+        stored += arr.nbytes
+        if arr.ndim == 4:  # the code/value pool, not a scale sidecar
+            fp32 += arr.size * 4
+    return stored, fp32

@@ -54,12 +54,12 @@ def tiny_disagg_setup():
     variables = {"params": init["params"]}
     trace = _fixed_trace(6, src_len, 96, seed=0)
 
-    def make_engine(phase, kv_block_size=4, speculate_gamma=0):
+    def make_engine(phase, kv_block_size=4, speculate_gamma=0, **kw):
         return Engine(model, variables, capacity=2, max_src_len=src_len,
                       queue_depth=len(trace),
                       default_max_new_tokens=max_new, decode_window=2,
                       kv_block_size=kv_block_size,
-                      speculate_gamma=speculate_gamma, phase=phase)
+                      speculate_gamma=speculate_gamma, phase=phase, **kw)
 
     baseline_engine = make_engine("both")
     ids = [baseline_engine.submit(src, max_new_tokens=max_new).id
@@ -274,6 +274,86 @@ def test_disagg_pair_token_parity_beam(tiny_disagg_setup):
     pre.release_handoff(req.id)
     dec.run_until_drained()
     assert list(dec.poll(new.id).tokens) == s["beam_baseline"]
+
+
+def test_disagg_int8_kv_handoff_round_trip(tiny_disagg_setup):
+    """Int8 pools on both sides of the split: the artifact ships int8
+    block codes plus their per-block scale sidecars as paired kv_*
+    leaves, and the whole trace resumes token-identically to a
+    co-located int8 engine (bounded-divergence parity is within the
+    quantized pair, like --quantize)."""
+    s = tiny_disagg_setup
+    both = s["make_engine"]("both", kv_quant="int8")
+    ids = [both.submit(src, max_new_tokens=s["max_new"]).id
+           for src in s["trace"]]
+    both.run_until_drained()
+    baseline = [list(both.poll(i).tokens) for i in ids]
+    pre = s["make_engine"]("prefill", kv_quant="int8")
+    dec = s["make_engine"]("decode", kv_quant="int8")
+    store = MemoryObjectStore()
+    out = []
+    for src in s["trace"]:
+        req = _park_one(pre, src, s["max_new"])
+        art = pre.export_handoff(req.id)
+        kv = [np.asarray(art[k]) for k in sorted(art)
+              if k.startswith("kv_")]
+        assert any(a.ndim == 4 and a.dtype == np.int8 for a in kv)
+        assert any(a.ndim == 2 and a.dtype == np.float32 for a in kv)
+        save_handoff(store, f"handoff/{req.id}", art)
+        new = dec.import_handoff(load_handoff(store, f"handoff/{req.id}"),
+                                 request_id=f"{req.id}#a1")
+        pre.release_handoff(req.id)
+        drop_handoff(store, f"handoff/{req.id}")
+        dec.run_until_drained()
+        out.append(list(dec.poll(new.id).tokens))
+    assert out == baseline
+
+
+def test_disagg_import_rejects_cross_precision(tiny_disagg_setup):
+    """An fp32 artifact must not land in an int8 pool (or vice versa):
+    the importer refuses before committing any state, and the exporter's
+    parked group survives for a matched retry."""
+    s = tiny_disagg_setup
+    pre_fp = s["make_engine"]("prefill")
+    req = _park_one(pre_fp, s["trace"][0], s["max_new"])
+    art = pre_fp.export_handoff(req.id)
+    dec_q = s["make_engine"]("decode", kv_quant="int8")
+    with pytest.raises(ValueError, match="kv-quant"):
+        dec_q.import_handoff(art, request_id="x#a1")
+    # Parked state intact — a matched-precision decode still resumes.
+    dec_fp = s["make_engine"]("decode")
+    new = dec_fp.import_handoff(art, request_id=req.id + "#a1")
+    pre_fp.release_handoff(req.id)
+    dec_fp.run_until_drained()
+    assert list(dec_fp.poll(new.id).tokens) == s["baseline"][0]
+    pre_q = s["make_engine"]("prefill", kv_quant="int8")
+    req2 = _park_one(pre_q, s["trace"][0], s["max_new"])
+    art2 = pre_q.export_handoff(req2.id)
+    with pytest.raises(ValueError, match="kv-quant"):
+        s["make_engine"]("decode").import_handoff(art2, request_id="y#a1")
+    pre_q.release_handoff(req2.id)
+
+
+def test_disagg_int8_decode_replica_spec_device_parity(tiny_disagg_setup):
+    """Device-resident speculation on an int8 decode replica: the import
+    warms the draft's dense fp cache from the DEQUANTIZED blocks, the
+    chain resumes mid-stream, and the tokens match the co-located int8
+    engine."""
+    s = tiny_disagg_setup
+    both = s["make_engine"]("both", kv_quant="int8")
+    r0 = both.submit(s["trace"][0], max_new_tokens=s["max_new"])
+    both.run_until_drained()
+    base = list(both.poll(r0.id).tokens)
+    pre = s["make_engine"]("prefill", kv_quant="int8")
+    dec = s["make_engine"]("decode", kv_quant="int8", speculate_gamma=2,
+                           speculate_device=True)
+    req = _park_one(pre, s["trace"][0], s["max_new"])
+    new = dec.import_handoff(pre.export_handoff(req.id),
+                             request_id=req.id + "#a1")
+    pre.release_handoff(req.id)
+    dec.run_until_drained()
+    assert list(dec.poll(new.id).tokens) == base
+    assert dec.metrics.spec_host_syncs_per_token is not None
 
 
 def test_disagg_decode_replica_speculation_parity(tiny_disagg_setup):

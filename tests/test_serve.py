@@ -1377,3 +1377,321 @@ def test_serve_bench_speculate_and_quantize_record_fields():
     assert rec["kv_bytes"] > 0
     assert rec["divergence_ok"] is True
     assert rec["logits_divergence"] <= rec["divergence_bound"]
+
+
+# -- device-resident spec chains + int8 KV cache -----------------------------
+
+
+@pytest.fixture(scope="module")
+def parity_direct(parity_setup):
+    model, variables, srcs = parity_setup
+    return [_direct_decode(model, variables, s, 1) for s in srcs]
+
+
+@pytest.fixture(scope="module")
+def int8_kv_baseline(parity_setup):
+    """Plain (non-speculative, window-1) int8-KV tokens — the reference
+    the int8 speculative parity checks compare against: int8 KV is
+    bounded-divergence vs fp32, so parity is WITHIN the quantized
+    engine, exactly like the --quantize contract."""
+    model, variables, srcs = parity_setup
+    eng = Engine(model, variables, capacity=2, max_src_len=PARITY_SRC_LEN,
+                 default_max_new_tokens=PARITY_NEW_TOKENS,
+                 kv_block_size=4, kv_quant="int8")
+    reqs = [eng.submit(s) for s in srcs]
+    eng.run_until_drained()
+    return [decoding.strip_special(eng.poll(r.id).tokens) for r in reqs]
+
+
+@pytest.mark.parametrize("kv", ["fp32", "int8"])
+@pytest.mark.parametrize("chain", [1, 4])
+@pytest.mark.parametrize("gamma", [2, 4])
+@pytest.mark.parametrize("paged", [False, True])
+def test_spec_device_chain_parity(parity_setup, parity_direct,
+                                  int8_kv_baseline, paged, gamma, chain,
+                                  kv):
+    """The tentpole grid: device-resident accept/advance is
+    token-identical to plain greedy across draft depths, chain lengths
+    (--decode-window), cache layouts, and KV precisions. fp32 compares
+    against the offline searcher; int8 against the plain int8-KV engine
+    (bounded-divergence contract, same as --quantize)."""
+    if kv == "int8" and not paged:
+        pytest.skip("int8 KV requires the paged pool")
+    model, variables, srcs = parity_setup
+    eng = Engine(model, variables, capacity=2, max_src_len=PARITY_SRC_LEN,
+                 default_max_new_tokens=PARITY_NEW_TOKENS,
+                 decode_window=chain, speculate_gamma=gamma,
+                 speculate_device=True,
+                 kv_block_size=4 if paged else 0,
+                 kv_quant="int8" if kv == "int8" else "")
+    reqs = [eng.submit(s) for s in srcs]
+    eng.run_until_drained()
+    got = [decoding.strip_special(eng.poll(r.id).tokens) for r in reqs]
+    assert got == (parity_direct if kv == "fp32" else int8_kv_baseline)
+    if kv == "fp32":
+        # Self-draft on an unquantized pool: acceptance is total.
+        assert eng.metrics.spec_accept_rate == pytest.approx(1.0)
+    syncs = eng.metrics.spec_host_syncs_per_token
+    assert syncs is not None and syncs > 0
+    assert eng.metrics.spec_windows_per_chain >= 1.0
+    if paged:
+        assert eng.allocator.blocks_in_use == 0  # leak-free drain
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_spec_device_chain_budget_truncation(parity_setup, paged):
+    """γ=4 chained 4 windows deep against a 3-token budget: the replay
+    must truncate mid-chain exactly like the host path — same tokens as
+    a plain engine at the same budget, never a token past it."""
+    model, variables, srcs = parity_setup
+    kw = dict(capacity=2, max_src_len=PARITY_SRC_LEN,
+              default_max_new_tokens=3, kv_block_size=4 if paged else 0)
+    plain = Engine(model, variables, **kw)
+    plain_reqs = [plain.submit(s) for s in srcs]
+    plain.run_until_drained()
+    dev = Engine(model, variables, speculate_gamma=4,
+                 speculate_device=True, decode_window=4, **kw)
+    dev_reqs = [dev.submit(s) for s in srcs]
+    dev.run_until_drained()
+    for pr, dr in zip(plain_reqs, dev_reqs):
+        assert dev.poll(dr.id).tokens == plain.poll(pr.id).tokens
+        assert len(dev.poll(dr.id).tokens) <= 3
+
+
+def test_spec_device_chain_eos_mid_chain(sched_model):
+    """An accepted EOS in a LATER window of the chain ends the request
+    there: the replay discards the remaining window positions, the row
+    releases, and the chain accounting records one sync for the whole
+    chain. Driven through a stubbed chain fn so the EOS lands
+    deterministically mid-chain."""
+    eng = _mk_engine(sched_model, speculate_gamma=2,
+                     speculate_device=True, decode_window=2, queue_depth=4)
+    req = eng.submit(_src(3), max_new_tokens=8)
+    cap, g, chain = eng.capacity, eng.speculate_gamma, eng.decode_window
+
+    def fake(*args):
+        cache, dcache = args[2], args[3]
+        tgts = np.full((chain, cap, g + 1), 7, np.int32)
+        accs = np.zeros((chain, cap), np.int32)
+        # Window 0: reject all → emit one correction token. Window 1:
+        # accept one draft token, whose target token is EOS.
+        accs[1, :] = 1
+        tgts[1, :, 1] = decoding.EOS_ID
+        return tgts, accs, cache, dcache
+
+    eng._spec_chain_fns[chain] = fake
+    eng.step()
+    assert eng.poll(req.id).tokens == [7, 7, decoding.EOS_ID]
+    assert eng.poll(req.id).state is RequestState.DONE
+    assert eng.active_rows == 0
+    assert eng.metrics.spec_windows_per_chain == pytest.approx(2.0)
+    assert eng.metrics.spec_host_syncs_per_token == pytest.approx(1 / 3)
+
+
+def test_spec_device_chain_fewer_syncs_than_host_path(parity_setup):
+    """The acceptance criterion, at engine level: at γ=4/chain=4 the
+    device path pays strictly fewer host syncs per emitted token than
+    the host accept loop on the same trace (same tokens, fewer
+    round-trips)."""
+    model, variables, srcs = parity_setup
+    kw = dict(capacity=2, max_src_len=PARITY_SRC_LEN,
+              default_max_new_tokens=PARITY_NEW_TOKENS,
+              speculate_gamma=4, decode_window=4, kv_block_size=4)
+    host = Engine(model, variables, **kw)
+    h_reqs = [host.submit(s) for s in srcs]
+    host.run_until_drained()
+    dev = Engine(model, variables, speculate_device=True, **kw)
+    d_reqs = [dev.submit(s) for s in srcs]
+    dev.run_until_drained()
+    for hr, dr in zip(h_reqs, d_reqs):
+        assert dev.poll(dr.id).tokens == host.poll(hr.id).tokens
+    h = host.metrics.spec_host_syncs_per_token
+    d = dev.metrics.spec_host_syncs_per_token
+    assert h is not None and d is not None
+    assert d < h
+
+
+def test_spec_device_and_kv_quant_validation(sched_model):
+    model, variables = sched_model
+    with pytest.raises(ValueError, match="speculate_gamma"):
+        Engine(model, variables, speculate_device=True)
+    with pytest.raises(ValueError, match="kv_block_size"):
+        Engine(model, variables, kv_quant="int8")  # dense layout
+    with pytest.raises(ValueError):
+        Engine(model, variables, kv_quant="int4", kv_block_size=4)
+
+
+def test_kv_quant_pool_structure_and_bytes(sched_model):
+    """The int8 pool is int8 codes + per-block/per-head fp32 scale
+    sidecars, its as-stored footprint meets the ≤0.30× contract, and the
+    serve_kv_quant_bytes gauge reports exactly that footprint."""
+    from deeplearning_cfn_tpu.serve.quant import kv_pool_bytes
+
+    eng = _mk_engine(sched_model, kv_block_size=4, kv_quant="int8")
+    nb = eng.kv_blocks
+    leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(eng.cache)]
+    codes = [l for l in leaves if l.ndim == 4 and l.shape[0] == nb]
+    scales = [l for l in leaves if l.ndim == 2 and l.shape[0] == nb]
+    assert codes and len(codes) == len(scales)  # every pool has a sidecar
+    assert all(l.dtype == np.int8 for l in codes)
+    assert all(l.dtype == np.float32 for l in scales)
+    assert all(s.shape[1] == c.shape[1]  # one scale per (block, head)
+               for c, s in zip(codes, scales))
+    stored, fp32 = kv_pool_bytes(eng.cache, nb)
+    assert 0 < stored <= 0.30 * fp32
+    assert eng.metrics.snapshot()["serve_kv_quant_bytes"] == stored
+
+
+def test_kv_quant_window_invariance(sched_model):
+    """Int8 KV serving is decode-window invariant: the requantize write
+    path and dequant gather commute with window fusion."""
+    srcs = [_src(i) for i in range(4)]
+    outs = []
+    for w in (1, 2):
+        eng = _mk_engine(sched_model, kv_block_size=4, kv_quant="int8",
+                         decode_window=w, queue_depth=8)
+        reqs = [eng.submit(s, max_new_tokens=8) for s in srcs]
+        eng.run_until_drained()
+        assert all(eng.poll(r.id).state is RequestState.DONE for r in reqs)
+        outs.append([eng.poll(r.id).tokens for r in reqs])
+    assert outs[0] == outs[1]
+
+
+def test_kv_quant_divergence_bounded(sched_model):
+    """Teacher-forced paged decode fp32-vs-int8-KV stays inside the same
+    relative logits bound the bench gates on (the --quantize contract,
+    applied to the cache)."""
+    from deeplearning_cfn_tpu.serve.bench import _kv_quant_divergence
+
+    model, variables = sched_model
+    diff, bound, ok = _kv_quant_divergence(model, variables,
+                                           SCHED_SRC_LEN, SCHED_VOCAB,
+                                           seed=0)
+    assert ok is True and diff <= bound
+
+
+def test_kv_quant_beam_cow_preserves_scales(sched_model):
+    """Beam forks COW tail blocks WITH their scale sidecars: an int8
+    beam run matches the fp32-KV beam choice on this trace and is
+    decode-window invariant — a fork that dropped scales would misdecode
+    the copied block and diverge on both counts."""
+    def run(kv_quant, w):
+        eng = _mk_engine(sched_model, kv_block_size=2, kv_quant=kv_quant,
+                         decode_window=w, queue_depth=4)
+        r = eng.submit(_src(9), max_new_tokens=6, beam_size=2)
+        eng.run_until_drained()
+        assert eng.poll(r.id).state is RequestState.DONE
+        assert eng.allocator.blocks_in_use == 0
+        return eng.poll(r.id).tokens
+
+    fp32 = run("", 1)
+    assert run("int8", 1) == fp32
+    assert run("int8", 2) == fp32
+
+
+def test_kv_quant_composes_with_weight_quant_and_spec_device(sched_model):
+    """All three knobs at once — int8 weights, int8 KV, device-resident
+    speculation — serve token-identically to the plain engine with the
+    same two quantizers (parity within the quantized pair)."""
+    kw = dict(kv_block_size=4, kv_quant="int8", quantize="int8",
+              queue_depth=8)
+    plain = _mk_engine(sched_model, **kw)
+    spec = _mk_engine(sched_model, speculate_gamma=2,
+                      speculate_device=True, decode_window=2, **kw)
+    srcs = [_src(i) for i in range(4)]
+    p_reqs = [plain.submit(s, max_new_tokens=8) for s in srcs]
+    plain.run_until_drained()
+    s_reqs = [spec.submit(s, max_new_tokens=8) for s in srcs]
+    spec.run_until_drained()
+    for pr, sr in zip(p_reqs, s_reqs):
+        assert spec.poll(sr.id).tokens == plain.poll(pr.id).tokens
+    assert spec.metrics.spec_host_syncs_per_token is not None
+
+
+def test_distilled_draft_preset_loads():
+    from deeplearning_cfn_tpu.serve.loader import (
+        DRAFT_PRESETS,
+        distilled_draft,
+    )
+
+    assert "tiny-distilled" in DRAFT_PRESETS
+    draft, dvars = distilled_draft("tiny-distilled")
+    leaves = jax.tree_util.tree_leaves(dvars)
+    assert leaves and all(np.asarray(l).size > 0 for l in leaves)
+    with pytest.raises(ValueError, match="tiny-distilled"):
+        distilled_draft("no-such-preset")
+
+
+def test_distilled_draft_real_accept_rate_with_parity():
+    """The committed distilled draft against the exact bench teacher it
+    was distilled from (random-init tiny NMT, seed 0): token parity with
+    the plain engine AND a real (non-ceiling) accept rate — the draft
+    genuinely predicts the teacher instead of merely aliasing it."""
+    from deeplearning_cfn_tpu.serve.bench import _fixed_trace
+    from deeplearning_cfn_tpu.serve.loader import distilled_draft
+
+    src_len = 8
+    model = transformer_nmt_tiny(vocab_size=96, max_len=64)
+    init = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, src_len), np.int32),
+        np.ones((1, src_len), np.int32),
+        np.zeros((1, src_len), np.int32), train=False)
+    variables = {"params": init["params"]}
+    draft, dvars = distilled_draft()
+    trace = _fixed_trace(4, src_len, 96, seed=0)
+    kw = dict(capacity=2, max_src_len=src_len, queue_depth=8,
+              default_max_new_tokens=8)
+    plain = Engine(model, variables, **kw)
+    p_ids = [plain.submit(s).id for s in trace]
+    plain.run_until_drained()
+    spec = Engine(model, variables, speculate_gamma=4, draft_model=draft,
+                  draft_variables=dvars, **kw)
+    s_ids = [spec.submit(s).id for s in trace]
+    spec.run_until_drained()
+    assert [spec.poll(i).tokens for i in s_ids] == \
+        [plain.poll(i).tokens for i in p_ids]
+    rate = spec.metrics.spec_accept_rate
+    assert rate is not None and 0.5 <= rate <= 1.0
+
+
+def test_serve_bench_spec_device_kv_quant_record_fields():
+    """The bench record carries the chain/sync and KV-footprint fields
+    (and their contracts) the new t1 gates assert on."""
+    from deeplearning_cfn_tpu.serve.bench import run_serve_bench
+
+    rec = run_serve_bench(num_requests=4, slots=2, max_new_tokens=4,
+                          src_len=8, speculate=2, speculate_device=True,
+                          kv_quant="int8", smoke=True)
+    assert rec["speculate_device"] is True
+    assert rec["kv_quant"] == "int8"
+    assert rec["token_identical"] is True
+    assert rec["spec_chain_len_p50"] is not None
+    assert rec["host_syncs_per_token"] is not None
+    assert rec["host_syncs_per_token_host_path"] is not None
+    assert rec["kv_cache_bytes"] <= 0.30 * rec["kv_cache_bytes_fp32"]
+    assert rec["kv_divergence_ok"] is True
+    assert rec["kv_divergence"] <= rec["kv_divergence_bound"]
+
+
+def test_serve_metrics_chain_and_kv_quant_keys_are_conditional():
+    """serve_spec_chain_* / serve_kv_quant_bytes exist only once their
+    feature is configured — the same conditional-surface contract as the
+    spec/paged/prefix keys."""
+    base = ServeMetrics(capacity=2, clock=FakeClock())
+    snap = base.snapshot()
+    assert "serve_kv_quant_bytes" not in snap
+    assert not any(k.startswith("serve_spec_chain") for k in snap)
+    m = ServeMetrics(capacity=2, clock=FakeClock())
+    m.configure_speculation(4)
+    m.configure_spec_chain(True)
+    m.record_spec_chain(windows=4, syncs=1, emitted=6)
+    snap = m.snapshot()
+    assert snap["serve_spec_device"] is True
+    assert snap["serve_spec_chain_windows"] == 4
+    assert snap["serve_spec_chain_syncs"] == 1
+    assert snap["serve_spec_windows_per_chain"] == pytest.approx(4.0)
+    assert snap["serve_spec_host_syncs_per_token"] == pytest.approx(1 / 6)
+    assert snap["serve_spec_chain_len_p50"] == pytest.approx(4.0)
+    mq = ServeMetrics(capacity=2, clock=FakeClock())
+    mq.configure_kv_quant(1234)
+    assert mq.snapshot()["serve_kv_quant_bytes"] == 1234

@@ -10,6 +10,12 @@ contract properties end to end:
 - the KV handoff shows up as a cross-process flow link in the merged
   Perfetto export: at least one trace_id has ``serve.request`` spans on
   BOTH the prefill-0 and decode-0 processes.
+
+A second pass reruns the same trace with ``kv_quant="int8"``: the
+handoff then ships int8 block codes + per-block scale sidecars, and the
+zero-drop / parity contract must hold unchanged (parity is against the
+int8-KV single-engine oracle — int8 KV is bounded-divergence vs fp32,
+not bit-identical).
 """
 
 import json
@@ -62,8 +68,15 @@ def main() -> int:
                   if any(n.startswith("prefill-0") for n in shards)
                   and any(n.startswith("decode-0") for n in shards)]
         assert hopped, {t: sorted(v) for t, v in by_trace.items()}
+    rq = run_fleet_bench(smoke=True, prefill_replicas=1,
+                         decode_replicas=1, trace=trace,
+                         kv_quant="int8")
+    assert rq["dropped_requests"] == 0, rq
+    assert rq["token_identical"] is True, rq
+    assert rq["token_identical_colocated"] is True, rq
+    assert rq["handoffs"] >= 1, rq
     print(f"DISAGG_SMOKE=OK handoffs={r['handoffs']} "
-          f"hopped_traces={len(hopped)}")
+          f"hopped_traces={len(hopped)} int8kv_handoffs={rq['handoffs']}")
     return 0
 
 

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Distill the committed "tiny-distilled" draft for speculative serving.
+
+`dlcfn-tpu bench --serve --speculate γ` defaults to SELF-draft: the draft
+IS the target, so every proposal is accepted and the reported accept rate
+is a ceiling (1.0) rather than a measurement. This tool produces the real
+shrunk draft the bench (and serve/loader.py ``draft_cfg="tiny-distilled"``)
+loads instead: a quarter-size transformer_nmt_tiny distilled against the
+EXACT teacher the bench builds — the random-init tiny preset at seed 0 —
+by teacher-logit (KL) distillation over the teacher's own greedy
+trajectories.
+
+Training sources mix the WMT sliver fixture sentences (bytes folded into
+the tiny vocab, ``3 + (b % 93)`` — the reserved-id framing data/text.py
+uses) with draws from the bench's seeded `_fixed_trace` family, so the
+measured accept rate on the bench trace reflects in-distribution
+distillation, not memorization of the eval trace itself (the bench trace
+seed is excluded from training).
+
+Run from the repo root (CPU, ~a minute):
+
+    python tools/distill_draft.py
+
+Writes deeplearning_cfn_tpu/serve/data/draft_tiny_distilled.npz — a flat
+{"a/b/c": array} params tree (see serve/loader.py distilled_draft) —
+and prints the held-out greedy agreement rate (≈ the accept rate the
+bench will measure).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+from flax import traverse_util  # noqa: E402
+
+from deeplearning_cfn_tpu.models.decoding import BOS_ID, EOS_ID  # noqa: E402
+from deeplearning_cfn_tpu.models.transformer_nmt import \
+    transformer_nmt_tiny  # noqa: E402
+from deeplearning_cfn_tpu.serve.bench import _fixed_trace  # noqa: E402
+from deeplearning_cfn_tpu.serve.loader import DRAFT_PRESETS  # noqa: E402
+
+VOCAB, MAX_LEN, SRC_LEN, TRAJ_LEN = 96, 64, 12, 16
+OUT = os.path.join(REPO, "deeplearning_cfn_tpu", "serve", "data",
+                   "draft_tiny_distilled.npz")
+
+
+def sliver_sources():
+    """WMT sliver sentences → tiny-vocab id sequences, the byte-fold
+    framing: ids 0..2 are reserved (PAD/BOS/EOS)."""
+    out = []
+    data = os.path.join(REPO, "tests", "data")
+    for lang in ("en", "de"):
+        with open(os.path.join(data, f"wmt_sliver.{lang}"), "rb") as fh:
+            for ln in fh:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                ids = [3 + (b % 93) for b in ln][:SRC_LEN]
+                if len(ids) >= 2:
+                    out.append(ids)
+    return out
+
+
+def pad_batch(srcs):
+    src = np.zeros((len(srcs), SRC_LEN), np.int32)
+    for i, s in enumerate(srcs):
+        src[i, :len(s)] = s
+    mask = (src != 0).astype(np.int32)
+    return src, mask
+
+
+def main():
+    # The teacher is byte-for-byte what run_serve_bench builds: the tiny
+    # preset, random-init at the bench's default seed.
+    teacher = transformer_nmt_tiny(vocab_size=VOCAB, max_len=MAX_LEN)
+    t_vars = teacher.init(
+        jax.random.PRNGKey(0), np.zeros((1, SRC_LEN), np.int32),
+        np.ones((1, SRC_LEN), np.int32), np.zeros((1, SRC_LEN), np.int32),
+        train=False)
+    t_vars = {"params": t_vars["params"]}
+
+    kwargs, _ = DRAFT_PRESETS["tiny-distilled"]
+    draft = transformer_nmt_tiny(**kwargs)
+    d_params = draft.init(
+        jax.random.PRNGKey(7), np.zeros((1, SRC_LEN), np.int32),
+        np.ones((1, SRC_LEN), np.int32), np.zeros((1, SRC_LEN), np.int32),
+        train=False)["params"]
+
+    # Training sources: sliver byte-folds + seeded trace family draws.
+    # Seed 0 is the bench's default eval trace — held out of training.
+    srcs = sliver_sources()
+    for seed in range(1, 9):
+        srcs.extend(_fixed_trace(16, SRC_LEN, VOCAB, seed=seed))
+    src, mask = pad_batch(srcs)
+
+    @jax.jit
+    def teacher_traj(src, mask):
+        """Teacher greedy trajectories + per-position teacher logits:
+        tgt_in[:, 0] = BOS (the engine's greedy framing), logits[:, t]
+        scores position t+1. Full-sequence `decode` per step — O(T²) but
+        the preset is tiny and this runs once."""
+        enc = teacher.apply(t_vars, src, mask, method=type(teacher).encode)
+        b = src.shape[0]
+        tgt = jnp.full((b, TRAJ_LEN + 1), 0, jnp.int32).at[:, 0].set(BOS_ID)
+        for t in range(TRAJ_LEN):
+            logits = teacher.apply(t_vars, tgt[:, :t + 1], enc, mask,
+                                   method=type(teacher).decode)
+            tgt = tgt.at[:, t + 1].set(jnp.argmax(logits[:, -1], axis=-1)
+                                       .astype(jnp.int32))
+        full = teacher.apply(t_vars, tgt[:, :-1], enc, mask,
+                             method=type(teacher).decode)
+        return tgt, full
+
+    tgt, t_logits = teacher_traj(src, mask)
+    # Distill only up to (and including) the first EOS: the engine never
+    # decodes past it, and post-EOS teacher behavior is noise.
+    is_eos = np.asarray(tgt[:, 1:]) == EOS_ID
+    first_eos = np.where(is_eos.any(1), is_eos.argmax(1), TRAJ_LEN)
+    valid = (np.arange(TRAJ_LEN)[None, :]
+             <= first_eos[:, None]).astype(np.float32)
+
+    tx = optax.adam(3e-3)
+    opt_state = tx.init(d_params)
+
+    @jax.jit
+    def step(params, opt_state, src, mask, tgt, t_logits, valid):
+        def loss_fn(p):
+            enc = draft.apply({"params": p}, src, mask,
+                              method=type(draft).encode)
+            d_logits = draft.apply({"params": p}, tgt[:, :-1], enc, mask,
+                                   method=type(draft).decode)
+            t_lp = jax.nn.log_softmax(t_logits.astype(jnp.float32))
+            d_lp = jax.nn.log_softmax(d_logits.astype(jnp.float32))
+            kl = jnp.sum(jnp.exp(t_lp) * (t_lp - d_lp), axis=-1)
+            return jnp.sum(kl * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.RandomState(0)
+    n, bsz = src.shape[0], 64
+    for it in range(1500):
+        idx = rng.randint(0, n, size=bsz)
+        d_params, opt_state, loss = step(
+            d_params, opt_state, src[idx], mask[idx], tgt[idx],
+            t_logits[idx], valid[idx])
+        if it % 250 == 0:
+            print(f"step {it:4d}  kl {float(loss):.4f}")
+
+    # Held-out agreement: the bench's actual seed-0 trace, teacher-forced
+    # on the TEACHER trajectory — exactly the accept test speculation
+    # applies to each proposed token.
+    ev_src, ev_mask = pad_batch(_fixed_trace(16, SRC_LEN, VOCAB, seed=0))
+    ev_tgt, ev_logits = teacher_traj(ev_src, ev_mask)
+    enc = draft.apply({"params": d_params}, ev_src, ev_mask,
+                      method=type(draft).encode)
+    d_logits = draft.apply({"params": d_params}, np.asarray(ev_tgt)[:, :-1],
+                           enc, ev_mask, method=type(draft).decode)
+    agree = np.asarray(jnp.argmax(d_logits, -1)
+                       == jnp.argmax(ev_logits, -1))
+    is_eos = np.asarray(ev_tgt[:, 1:]) == EOS_ID
+    first = np.where(is_eos.any(1), is_eos.argmax(1), TRAJ_LEN)
+    ev_valid = np.arange(TRAJ_LEN)[None, :] <= first[:, None]
+    rate = float(agree[ev_valid].mean())
+    print(f"held-out greedy agreement (≈ accept rate): {rate:.3f}")
+
+    flat = {"/".join(k): np.asarray(v) for k, v in
+            traverse_util.flatten_dict(d_params).items()}
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    np.savez_compressed(OUT, **flat)
+    size = os.path.getsize(OUT)
+    print(f"wrote {OUT} ({size / 1024:.0f} KiB, {len(flat)} arrays)")
+
+
+if __name__ == "__main__":
+    main()
