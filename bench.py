@@ -213,7 +213,9 @@ def _finalize_green(record: dict, alive: bool, probe_note: str,
                     "decode_p95_no_adversary",
                     "handoff_latency_p50_s", "handoff_latency_p95_s",
                     "handoff_bytes", "kv_cache_bytes",
-                    "spec_chain_len_p50", "host_syncs_per_token"):
+                    "spec_chain_len_p50", "host_syncs_per_token",
+                    "offered_load_rps", "scale_events",
+                    "time_to_scale_s", "p95_during_burst"):
             if key in record:
                 record[key] = None
     return record

@@ -340,6 +340,12 @@ def _cmd_bench(args) -> int:
         print("[dlcfn-tpu] --smoke is a serving-scenario mode — pass it "
               "with --serve or --fleet", file=sys.stderr)
         return 2
+    if (getattr(args, "autoscale", False)
+            or getattr(args, "trace", None)) \
+            and not getattr(args, "fleet", False):
+        print("[dlcfn-tpu] --trace/--autoscale are fleet-scenario flags — "
+              "pass them with --fleet", file=sys.stderr)
+        return 2
     if getattr(args, "fleet", False):
         if getattr(args, "ops", None) or args.collectives or \
                 getattr(args, "sweep_batches", None) or \
@@ -347,6 +353,10 @@ def _cmd_bench(args) -> int:
             print("[dlcfn-tpu] --fleet is its own scenario — don't combine "
                   "with --serve/--ops/--collectives/--sweep-batches",
                   file=sys.stderr)
+            return 2
+        if getattr(args, "autoscale", False) and not args.trace:
+            print("[dlcfn-tpu] --autoscale needs --trace (the controller "
+                  "runs on the open-loop replay clock)", file=sys.stderr)
             return 2
         from ..fleet.bench import run_fleet_bench
 
@@ -363,7 +373,11 @@ def _cmd_bench(args) -> int:
                                trace_mix=args.trace_mix,
                                speculate=args.speculate,
                                speculate_device=args.speculate_device,
-                               kv_quant=args.kv_quant)
+                               kv_quant=args.kv_quant,
+                               trace_spec=args.trace,
+                               autoscale=args.autoscale,
+                               min_replicas=args.min_replicas,
+                               max_replicas=args.max_replicas)
         print(json.dumps(line))
         return 0
     if getattr(args, "obs_smoke", False):
@@ -1853,6 +1867,24 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fleet scenario: crash-inject replica-0 on its "
                          "Nth decode step (0 = off) — the chaos variant "
                          "of the zero-drop contract")
+    be.add_argument("--trace", default=None, metavar="SPEC",
+                    help="fleet scenario: open-loop trace replay — "
+                         "'poisson' | 'burst' | 'diurnal', optionally "
+                         "parameterized ('burst:requests=12,"
+                         "burst_s=0.2'); drives Router.submit on a "
+                         "virtual clock from a seeded arrival schedule")
+    be.add_argument("--autoscale", action="store_true",
+                    help="fleet scenario: closed-loop autoscaling over "
+                         "the replayed trace — starts at --min-replicas, "
+                         "scales between the bounds on SignalBus "
+                         "pressure with hysteresis + cooldown, "
+                         "scale-down as a zero-drop drain (needs "
+                         "--trace)")
+    be.add_argument("--min-replicas", type=int, default=1,
+                    help="fleet scenario: autoscale floor (default 1)")
+    be.add_argument("--max-replicas", type=int, default=0,
+                    help="fleet scenario: autoscale ceiling (default: "
+                         "--fleet-replicas)")
     be.add_argument("--fleet-trace-dir", default=None,
                     help="fleet scenario: write per-replica span shards, "
                          "router fleet.request spans and the signal "

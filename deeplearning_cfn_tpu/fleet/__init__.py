@@ -19,6 +19,9 @@ the serving half it never had.
   with zero dropped requests.
 - :mod:`.rollout` — rolling checkpoint upgrades: drain → swap → probe →
   readmit, one replica at a time, fleet keeps serving throughout.
+- :mod:`.autoscale` — closed-loop membership control: SignalBus
+  pressure through hysteresis + cooldown into phase-aware scale-up
+  (spawn + register) and zero-drop drain-based scale-down.
 - :mod:`.bench` — `dlcfn-tpu bench --fleet`: aggregate tokens/sec,
   per-replica utilization, and the token-parity/zero-drop contract
   record CI gates on.
@@ -26,6 +29,12 @@ the serving half it never had.
 CLI surface: `dlcfn-tpu fleet up | route | rollout | status`.
 """
 
+from .autoscale import (  # noqa: F401
+    AutoscalePolicy,
+    Autoscaler,
+    SupervisedSpawner,
+    pool_signals,
+)
 from .replica import (  # noqa: F401
     EngineReplica,
     ReplicaCrashed,
@@ -50,6 +59,8 @@ from .rollout import (  # noqa: F401
 )
 
 __all__ = [
+    "AutoscalePolicy",
+    "Autoscaler",
     "EngineReplica",
     "FleetOverloadError",
     "LeastLoadedPolicy",
@@ -64,6 +75,8 @@ __all__ = [
     "Router",
     "RoundRobinPolicy",
     "RoutingPolicy",
+    "SupervisedSpawner",
+    "pool_signals",
     "restore_swap_variables",
     "rolling_upgrade",
 ]

@@ -27,11 +27,13 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..loadgen import LoadGenerator, VirtualClock, parse_trace_spec, replay
 from ..runtime.faults import FaultPlan, FaultSpec
 from ..serve.bench import _fixed_trace
 from ..serve.engine import Engine
 from ..serve.metrics import percentile
 from ..serve.queue import OverloadError
+from .autoscale import AutoscalePolicy, Autoscaler
 from .replica import EngineReplica
 from .router import Router
 
@@ -106,7 +108,12 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
                     trace: Optional[List[List[int]]] = None,
                     speculate: int = 0,
                     speculate_device: bool = False,
-                    kv_quant: str = "") -> Dict:
+                    kv_quant: str = "",
+                    trace_spec: Optional[str] = None,
+                    autoscale: bool = False,
+                    min_replicas: int = 1,
+                    max_replicas: int = 0,
+                    tick_s: float = 0.05) -> Dict:
     """Route the fixed trace across the fleet to drain; return the
     BENCH-contract record with the fleet fields. ``smoke`` shrinks the
     scenario AND runs the single-engine parity baseline (the t1.sh gate
@@ -140,7 +147,26 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
     to ``<dir>/<replica>/metrics.jsonl``, the router writes its
     ``fleet.request`` spans to ``<dir>/router.jsonl`` and the end-of-run
     signal snapshot to ``<dir>/signals.jsonl`` — the layout
-    ``obs export --fleet <dir>`` merges into one Perfetto timeline."""
+    ``obs export --fleet <dir>`` merges into one Perfetto timeline.
+
+    ``trace_spec`` (a ``--trace`` string, e.g. ``"burst"`` or
+    ``"poisson:rate=8,duration=2"``) replaces the fixed submit-to-drain
+    loop with OPEN-LOOP replay: a seeded :class:`~..loadgen
+    .LoadGenerator` schedule drives ``Router.submit`` on a
+    :class:`~..loadgen.VirtualClock` shared by the router AND every
+    engine, so queue waits, retry-after hints, and latency percentiles
+    are virtual-time quantities — fully deterministic under the seed.
+    A ``trace`` prompt list then serves as the replay's prompt corpus.
+
+    ``autoscale`` (requires ``trace_spec``) arms the closed loop: the
+    fleet starts at ``min_replicas`` and an :class:`~.autoscale
+    .Autoscaler` fed by a live SignalBus scales it between
+    ``min_replicas`` and ``max_replicas`` (default: ``replicas``) on
+    the replay clock, emitting ``scale_event`` records into the record
+    (and ``<trace_dir>/autoscale.jsonl``). The contract: scale-up on
+    the burst onset, drain-based scale-down in the trough,
+    ``dropped_requests == 0``, and ``token_identical`` against a
+    FIXED fleet of ``max_replicas`` replaying the same schedule."""
     import jax
 
     from ..models.transformer_nmt import transformer_nmt_tiny
@@ -154,12 +180,22 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
     if trace_mix not in ("uniform", "prefill-heavy"):
         raise ValueError(f"unknown trace mix {trace_mix!r}")
     disagg = prefill_replicas > 0
+    if autoscale and trace_spec is None:
+        raise ValueError("autoscale needs a trace spec (--trace): the "
+                         "controller runs on the open-loop replay clock")
+    if trace_spec is not None and disagg:
+        raise ValueError("trace replay does not drive disaggregated "
+                         "topologies yet (use the fixed-trace bench)")
+    if min_replicas < 1:
+        raise ValueError(f"min_replicas must be >= 1, got {min_replicas}")
     if smoke:
         replicas = 2
         if disagg:
             prefill_replicas = decode_replicas = 1
         num_requests, slots = min(num_requests, 6), min(slots, 2)
         max_new_tokens, src_len = min(max_new_tokens, 4), min(src_len, 8)
+    if autoscale and max_replicas <= 0:
+        max_replicas = max(replicas, min_replicas)
 
     model = transformer_nmt_tiny(vocab_size=96, max_len=64)
     init = model.init(
@@ -167,7 +203,23 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         np.zeros((1, src_len), np.int32), np.ones((1, src_len), np.int32),
         np.zeros((1, src_len), np.int32), train=False)
     variables = {"params": init["params"]}
-    if trace is not None:
+    spec = gen = vclock = None
+    if trace_spec is not None:
+        # Open-loop replay: the seeded schedule is the trace. A `trace`
+        # prompt list becomes the generator's prompt corpus; the bench
+        # mix maps onto the spec unless the spec string pins its own.
+        txt = trace_spec
+        if trace_mix != "uniform" and "mix=" not in txt:
+            txt += (":" if ":" not in txt else ",") + f"mix={trace_mix}"
+        spec = parse_trace_spec(txt, src_len=src_len,
+                                max_new_tokens=max_new_tokens,
+                                requests=num_requests)
+        gen = LoadGenerator(spec, seed=seed, vocab_size=96,
+                            prompt_corpus=trace)
+        pairs = gen.pairs()
+        num_requests = len(pairs)
+        vclock = VirtualClock()
+    elif trace is not None:
         pairs = [([int(t) for t in src], max_new_tokens) for src in trace]
         num_requests = len(pairs)
     elif trace_mix == "prefill-heavy":
@@ -192,6 +244,18 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
             op="step", key="prefill-0" if disagg else "replica-0",
             kind="crash", at_calls=(chaos_kill_step - 1,))])
 
+    # Under trace replay, every engine AND the router read ONE virtual
+    # clock — retry-after hints, queue waits, and latency percentiles
+    # become virtual-time quantities, so every autoscale decision is a
+    # pure function of the seed. ``_clock_ref`` is a rebindable cell so
+    # the fixed-fleet parity run gets a fresh clock through the same
+    # engine-building closure.
+    _clock_ref = [vclock]
+
+    def _fleet_clock():
+        return _clock_ref[0].read() if _clock_ref[0] is not None \
+            else time.monotonic()
+
     def _build_fleet(specs, plan):
         built: List[EngineReplica] = []
         warm: Dict[str, int] = {}
@@ -205,7 +269,8 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
                             speculate_gamma=speculate,
                             speculate_device=speculate_device,
                             kv_quant=kv_quant,
-                            phase=phase)
+                            phase=phase,
+                            clock=_fleet_clock)
             rep = EngineReplica(name, engine, fault_plan=plan)
             # Warmup per replica, outside the timed window (each engine
             # owns its own jit closures, so each compiles
@@ -255,10 +320,21 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         specs = [(f"prefill-{i}", "prefill")
                  for i in range(prefill_replicas)] \
             + [(f"decode-{i}", "decode") for i in range(decode_replicas)]
+    elif autoscale:
+        # The autoscaled fleet starts at the floor; the controller grows
+        # it toward max_replicas when the trace demands.
+        specs = [(f"replica-{i}", "both") for i in range(min_replicas)]
     else:
         specs = [(f"replica-{i}", "both") for i in range(replicas)]
     members, warmup_tokens = _build_fleet(specs, fault_plan)
-    router = Router(members, policy=policy)
+    if vclock is not None:
+        router = Router(members, policy=policy, clock=_fleet_clock)
+    else:
+        router = Router(members, policy=policy)
+    # Every replica that ever served traffic, in spawn order — retired
+    # replicas leave the router but keep their engines (and token
+    # counters) for the per-replica accounting below.
+    members_all = list(members)
 
     writers = []
     if trace_dir is not None:
@@ -281,8 +357,71 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
             rep_writers[rep.id] = w
             rep.trace_sink = JsonlSink(w)
 
+    scaler = None
+    report = None
+    as_policy = None
+    if autoscale:
+        from ..obs.signals import SignalBus
+
+        bus = SignalBus(names=[rep.id for rep in members])
+        as_policy = AutoscalePolicy(min_replicas=min_replicas,
+                                    max_replicas=max_replicas)
+
+        def _spawn(phase, rid):
+            built, w = _build_fleet([(rid, phase)], None)
+            warmup_tokens.update(w)
+            rep = built[0]
+            members_all.append(rep)
+            if trace_dir is not None:
+                w2 = MetricsWriter(
+                    os.path.join(trace_dir, rep.id, "metrics.jsonl"),
+                    also_stdout=False, all_processes=True)
+                writers.append(w2)
+                rep_writers[rep.id] = w2
+                rep.trace_sink = JsonlSink(w2)
+            return rep
+
+        event_sink = None
+        if trace_dir is not None:
+            autoscale_writer = MetricsWriter(
+                os.path.join(trace_dir, "autoscale.jsonl"),
+                also_stdout=False, all_processes=True)
+            writers.append(autoscale_writer)
+            # scale_event records carry their own (virtual) "ts", which
+            # MetricsWriter preserves over its wall stamp.
+            event_sink = autoscale_writer.write
+        scaler = Autoscaler(router, bus, _spawn, policy=as_policy,
+                            clock=vclock.read, event_sink=event_sink)
+
+        def _on_tick(now):
+            # Feed this tick's serve snapshots (live queue depth — the
+            # step-time gauge lags admission), then let the controller
+            # decide.
+            for rid2 in router.replica_ids():
+                rep2 = router.replica(rid2)
+                rec = rep2.engine.metrics.snapshot()
+                rec["serve_queue_depth"] = rep2.engine.queue.depth
+                bus.observe(rep2.id, rec, ts=now)
+            scaler.tick()
+    else:
+        _on_tick = None
+
     t0 = time.monotonic()
-    rids, ticks = _drive(router, pairs)
+    if gen is not None:
+        report = replay(gen, router, vclock, tick_s=tick_s,
+                        on_tick=_on_tick)
+        rids, ticks = report.rids, report.ticks
+        if scaler is not None and scaler.draining:
+            # A drain that began on the final tick still completes —
+            # keep ticking the (idle) fleet through the grace window.
+            for _ in range(as_policy.drain_grace_ticks + 1):
+                if not scaler.draining:
+                    break
+                router.step()
+                _on_tick(vclock.read())
+                vclock.advance(tick_s)
+    else:
+        rids, ticks = _drive(router, pairs)
     elapsed = time.monotonic() - t0
 
     results = [router.result(rid) for rid in rids]
@@ -293,7 +432,7 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
     lat = [r["latency_s"] for r in done if r["latency_s"] is not None]
     total_tokens = 0
     per_replica = []
-    for rep in members:
+    for rep in members_all:
         m = rep.engine.metrics
         toks = m.tokens_generated - warmup_tokens[rep.id]
         total_tokens += toks
@@ -322,8 +461,8 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
     if trace_dir is not None:
         from ..obs.signals import SignalBus
 
-        bus = SignalBus(names=[rep.id for rep in members])
-        for rep in members:
+        bus = SignalBus(names=[rep.id for rep in members_all])
+        for rep in members_all:
             rep.engine.metrics.emit(rep_writers[rep.id], replica=rep.id,
                                     phase=rep.phase)
             bus.observe(rep.id, rep.engine.metrics.snapshot())
@@ -333,13 +472,29 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         writers.append(signals_writer)
         signals_writer.write(bus.snapshot())
         router.trace_sink = None
-        for rep in members:
+        for rep in members_all:
             rep.trace_sink = None
         for w in writers:
             w.close()
 
     token_identical = None
-    if smoke:
+    if autoscale:
+        # The autoscale parity contract: the SAME schedule replayed
+        # through a FIXED fleet of max_replicas on a fresh virtual
+        # clock. Greedy decode is deterministic and the router never
+        # loses a request, so membership churn must not change a single
+        # token.
+        vclock2 = VirtualClock()
+        _clock_ref[0] = vclock2
+        f_members, _ = _build_fleet(
+            [(f"fixed-{i}", "both") for i in range(max_replicas)], None)
+        f_router = Router(f_members, policy=policy, clock=_fleet_clock)
+        f_report = replay(gen, f_router, vclock2, tick_s=tick_s)
+        f_results = [f_router.result(r) for r in f_report.rids]
+        token_identical = ([r["tokens"] for r in results]
+                           == [r["tokens"] for r in f_results])
+        _clock_ref[0] = vclock
+    elif smoke:
         baseline = _single_engine_tokens(
             model, variables, pairs, slots, src_len, max_new_tokens,
             decode_window, kv_block_size=kv_block_size,
@@ -348,6 +503,30 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         fleet_tokens = [r["tokens"] for r in results]
         token_identical = fleet_tokens == baseline
 
+    # Loadgen / autoscale derived fields (null when the feature is off —
+    # root bench.py _finalize_green nulls them for unmeasured records).
+    p95_during_burst = None
+    time_to_scale_s = None
+    scale_ups = scale_downs = 0
+    if gen is not None:
+        lo, hi = spec.hot_window()
+        burst_e2e = [
+            router.ledger[s.request_id]["e2e_s"] for s in gen.schedule
+            if lo <= s.at_s < hi and s.request_id in router.ledger
+            and router.ledger[s.request_id]["e2e_s"] is not None]
+        p95_during_burst = percentile(burst_e2e, 95)
+    if scaler is not None:
+        scale_ups = sum(1 for ev in scaler.events
+                        if ev["action"] == "scale_up")
+        scale_downs = sum(1 for ev in scaler.events
+                          if ev["action"] == "scale_down")
+        first_up = next((ev["ts"] for ev in scaler.events
+                         if ev["action"] == "scale_up"), None)
+        if first_up is not None and gen.schedule:
+            # Virtual seconds from the first arrival to the first
+            # scale-up — the controller's reaction time.
+            time_to_scale_s = round(first_up - gen.schedule[0].at_s, 6)
+
     record = {
         "metric": METRIC,
         "value": round(total_tokens / elapsed, 2) if elapsed > 0 else None,
@@ -355,7 +534,7 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         "vs_baseline": None,
         "mfu": None,
         "measured": True,
-        "replicas": len(members),
+        "replicas": len(members_all),
         "policy": router.policy.name,
         "dropped_requests": dropped,
         "evacuations": router.evacuations,
@@ -385,6 +564,31 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         "spec_gamma": speculate,
         "speculate_device": speculate_device,
         "kv_quant": kv_quant,
+        # -- open-loop replay / closed-loop autoscale -----------------
+        "trace_spec": trace_spec,
+        "autoscale": autoscale,
+        "offered_load_rps":
+            round(report.offered_load_rps, 3)
+            if report is not None and report.offered_load_rps is not None
+            else None,
+        "loadgen_rejections":
+            report.rejections if report is not None else None,
+        "retry_after_honored":
+            report.retries_honored if report is not None else None,
+        "arrival_schedule":
+            [[round(s.at_s, 6), len(s.src_ids), s.max_new_tokens]
+             for s in gen.schedule] if gen is not None else None,
+        "p95_during_burst": p95_during_burst,
+        "scale_events": list(scaler.events) if scaler is not None
+            else None,
+        "scale_ups": scale_ups if scaler is not None else None,
+        "scale_downs": scale_downs if scaler is not None else None,
+        "time_to_scale_s": time_to_scale_s,
+        "replicas_initial":
+            min_replicas if autoscale else len(members),
+        "replicas_final": len(router.replica_ids()),
+        "min_replicas": min_replicas if autoscale else None,
+        "max_replicas": max_replicas if autoscale else None,
     }
 
     if disagg:

@@ -222,6 +222,12 @@ class Router:
         self.handoffs = 0
         self.handoff_bytes_total = 0
         self.handoff_latencies: List[float] = []
+        # Results snapshotted off replicas that left the fleet (see
+        # _detach_finished): rid → result dict. Without this, a
+        # scale-down that removes a replica holding finished-but-unread
+        # results would strand them — poll() would KeyError on the
+        # vanished replica.
+        self._detached: Dict[str, Dict] = {}
 
     @property
     def handoff_store(self):
@@ -253,12 +259,50 @@ class Router:
         self.routed.setdefault(replica.id, 0)
 
     def remove(self, replica_id: str) -> None:
-        """Take a replica out of the fleet, evacuating its in-flight
-        work to the survivors first."""
+        """Take a replica out of the fleet: snapshot its finished
+        results (they stay readable through ``result``/``finished``
+        after the replica is gone), then evacuate its in-flight work to
+        the survivors."""
         r = self._replicas[replica_id]
-        self._evacuate(replica_id, cancel_on_replica=not r.crashed)
+        self._detach_finished(replica_id)
+        # Take the leaver out of the routable set WHILE evacuating:
+        # _place reads membership live, and a still-HEALTHY leaver with
+        # a freshly-cancelled (empty) queue is exactly where
+        # least-loaded would put the evacuated copy right back. The
+        # prior state is restored afterwards so re-adding the same
+        # handle later (readmission) works unchanged.
+        prior = r.state
+        if not r.crashed:
+            r.state = ReplicaState.DRAINING
+        try:
+            self._evacuate(replica_id, cancel_on_replica=not r.crashed)
+        finally:
+            r.state = prior
         del self._replicas[replica_id]
         self._failures.pop(replica_id, None)
+
+    def _detach_finished(self, rep_id: str) -> None:
+        """Snapshot every finished-but-still-resident result on
+        ``rep_id`` into the detached cache. Scale-down removes replicas
+        with completed, unread results as a matter of course — the
+        results must outlive the replica."""
+        r = self._replicas[rep_id]
+        for lr in list(self._requests.values()):
+            if lr.replica_id != rep_id or lr.replica_rid is None:
+                continue
+            try:
+                req = r.poll(lr.replica_rid)
+            except (KeyError, ReplicaCrashed):
+                continue
+            if req is None or not req.finished:
+                continue
+            self._finalize(lr, req)
+            out = req.to_dict()
+            out["id"] = lr.rid
+            out["replica"] = rep_id
+            self._detached[lr.rid] = out
+            lr.replica_id = None
+            lr.replica_rid = None
 
     def _routable(self) -> List[EngineReplica]:
         return [self._replicas[rid] for rid in self.replica_ids()
@@ -559,6 +603,8 @@ class Router:
         return self._replicas[lr.replica_id].poll(lr.replica_rid)
 
     def finished(self, rid: str) -> bool:
+        if rid in self._detached:
+            return True
         req = self.poll(rid)
         done = req is not None and req.finished
         if done:
@@ -569,6 +615,8 @@ class Router:
         return [rid for rid in self._requests if not self.finished(rid)]
 
     def result(self, rid: str) -> Dict:
+        if rid in self._detached:
+            return dict(self._detached[rid])
         req = self.poll(rid)
         if req is None:
             return {"id": rid, "state": "backlogged", "tokens": []}

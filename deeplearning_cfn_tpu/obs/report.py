@@ -337,6 +337,61 @@ def fleet_replica_dirs(root: str) -> List[Tuple[str, str]]:
     return found
 
 
+def _autoscale_events(root: str) -> List[Dict[str, Any]]:
+    """Every ``scale_event`` record in the fleet root's own top-level
+    ``*.jsonl`` shards (the bench writes them to
+    ``<root>/autoscale.jsonl``), in record-time order."""
+    events: List[Dict[str, Any]] = []
+    for f in sorted(os.listdir(root)):
+        p = os.path.join(root, f)
+        if not f.endswith(".jsonl") or not os.path.isfile(p):
+            continue
+        try:
+            recs, _ = _iter_records(p)
+        except OSError:
+            continue
+        events.extend(r for r in recs
+                      if r.get("event") == "scale_event")
+    events.sort(key=lambda r: r["ts"]
+                if isinstance(r.get("ts"), (int, float)) else 0.0)
+    return events
+
+
+def fold_autoscale(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold a scale-event stream into the autoscale summary: counters,
+    the derived controller state (steady / scaling-up / draining), and
+    the last event's what/why — the same fold ``obs tail --fleet``
+    applies live."""
+    ups = sum(1 for e in events if e.get("action") == "scale_up")
+    downs = sum(1 for e in events if e.get("action") == "scale_down")
+    drained = sum(1 for e in events
+                  if e.get("action") == "scale_down" and e.get("drained"))
+    open_drains = set()
+    for e in events:
+        if e.get("action") == "drain_begin":
+            open_drains.add(e.get("replica"))
+        elif e.get("action") == "scale_down":
+            open_drains.discard(e.get("replica"))
+    if open_drains:
+        state = "draining"
+    elif events and events[-1].get("action") == "scale_up":
+        state = "scaling-up"
+    else:
+        state = "steady"
+    last = events[-1] if events else {}
+    return {
+        "events": len(events),
+        "scale_ups": ups,
+        "scale_downs": downs,
+        "drained_scale_downs": drained,
+        "state": state,
+        "last_action": last.get("action"),
+        "last_replica": last.get("replica"),
+        "last_phase": last.get("phase"),
+        "last_reason": last.get("reason"),
+    }
+
+
 def summarize_fleet(root: str) -> Dict[str, Any]:
     """Fleet-wide report over a directory of per-replica run dirs (the
     ReplicaSupervisor layout: ``<root>/replica-<i>/``). Per-replica
@@ -375,7 +430,7 @@ def summarize_fleet(root: str) -> Dict[str, Any]:
         if isinstance(qd, (int, float)):
             queue_by_phase[phase] = \
                 queue_by_phase.get(phase, 0) + int(qd)
-    return {
+    out: Dict[str, Any] = {
         "source": {"path": root, "replicas": len(dirs),
                    "records": total_records},
         "fleet": {
@@ -395,16 +450,27 @@ def summarize_fleet(root: str) -> Dict[str, Any]:
         "signals": bus.snapshot(),
         "replicas": replicas,
     }
+    # Autoscale section only when the run actually scaled — legacy
+    # fixed-membership layouts summarize byte-identically.
+    events = _autoscale_events(root)
+    if events:
+        out["autoscale"] = fold_autoscale(events)
+    return out
 
 
 def fleet_status_line(summary: Dict[str, Any]) -> str:
     """The one-line fleet status (`dlcfn-tpu fleet status`)."""
     f = summary["fleet"]
     n = summary["source"]["replicas"]
-    return (f"fleet {n} replica(s) | {_fmt(f['tokens_per_sec'])} tok/s | "
+    line = (f"fleet {n} replica(s) | {_fmt(f['tokens_per_sec'])} tok/s | "
             f"done {_fmt(f['completed'])}/{_fmt(f['submitted'])} | "
             f"worst p95 {_fmt(f['worst_latency_p95_s'], 's')} | "
             f"alerts {f['alerts']}")
+    a = summary.get("autoscale")
+    if a:
+        line += (f" | scale {a['state']} "
+                 f"+{a['scale_ups']}/-{a['scale_downs']}")
+    return line
 
 
 def render_fleet_report(summary: Dict[str, Any]) -> str:
@@ -420,6 +486,13 @@ def render_fleet_report(summary: Dict[str, Any]) -> str:
                   if f["launch_failed_replicas"] else "")
         L.append(f"  launch: {f['launch_attempts']} attempt(s), "
                  f"{f['launch_restarts']} restart(s){failed}")
+    a = summary.get("autoscale")
+    if a:
+        why = f" — {a['last_reason']}" if a.get("last_reason") else ""
+        L.append(f"  autoscale: {a['state']} | "
+                 f"+{a['scale_ups']} up / -{a['scale_downs']} down "
+                 f"({a['drained_scale_downs']} drained) | last: "
+                 f"{a['last_action']} {a['last_replica']}{why}")
     qbp = f.get("queue_depth_by_phase")
     if qbp and set(qbp) != {"both"}:
         L.append("  queue depth by phase: " + "  ".join(

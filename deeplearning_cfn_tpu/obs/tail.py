@@ -174,10 +174,56 @@ class FleetTailState:
     def __init__(self, names: List[str]):
         from .signals import SignalBus
 
-        self.bus = SignalBus(names=names)
+        # "#"-prefixed names are control streams (the fleet root's own
+        # autoscale.jsonl), not replicas — they feed the scale fold
+        # below, never the bus.
+        self.bus = SignalBus(names=[n for n in names
+                                    if not n.startswith("#")])
+        # Live membership + autoscale fold. ``members`` maps replica →
+        # phase and tracks scale events as they stream in: a fleet's
+        # membership is no longer fixed for the life of one `fleet up`.
+        self.members: Dict[str, Optional[str]] = {
+            n: None for n in names if not n.startswith("#")}
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.last_scale: Optional[Dict[str, Any]] = None
+        self._open_drains: set = set()
+        self._scale_seen = False
 
     def update(self, name: str, rec: Dict[str, Any]) -> None:
+        if rec.get("event") == "scale_event":
+            self._scale_seen = True
+            action = rec.get("action")
+            replica = rec.get("replica")
+            phase = rec.get("phase")
+            if action == "scale_up":
+                self.scale_ups += 1
+                self.members[replica] = phase
+                self._open_drains.discard(replica)
+            elif action == "drain_begin":
+                self.members.setdefault(replica, phase)
+                self._open_drains.add(replica)
+            elif action == "scale_down":
+                self.scale_downs += 1
+                self._open_drains.discard(replica)
+                self.members.pop(replica, None)
+            self.last_scale = rec
+            return
+        if name.startswith("#"):
+            return
+        if name not in self.members:
+            self.members[name] = rec.get("phase")
+        elif self.members[name] is None and rec.get("phase"):
+            self.members[name] = rec.get("phase")
         self.bus.observe(name, rec)
+
+    def scale_state(self) -> str:
+        if self._open_drains:
+            return "draining"
+        if self.last_scale is not None \
+                and self.last_scale.get("action") == "scale_up":
+            return "scaling-up"
+        return "steady"
 
     def status_line(self) -> str:
         def _f(v: Any) -> str:
@@ -202,6 +248,20 @@ class FleetTailState:
         if fails:
             parts.append("launch " + ",".join(
                 f"{n}:{o}" for n, o in sorted(fails.items())))
+        if self._scale_seen:
+            # Autoscaled fleet: surface live membership (with phase)
+            # and the controller state + last event reason. Fixed
+            # fleets never see a scale_event, so the legacy line is
+            # unchanged byte for byte.
+            parts.append("members " + ",".join(
+                f"{n}:{self.members[n] or '?'}"
+                for n in sorted(self.members)))
+            last = self.last_scale or {}
+            why = f" — {last['reason']}" if last.get("reason") else ""
+            parts.append(
+                f"scale {self.scale_state()} "
+                f"(last: {last.get('action')} {last.get('replica')}"
+                f"{why})")
         return " | ".join(parts)
 
 
@@ -214,15 +274,19 @@ def _follow_paths(path: str) -> List[str]:
 
 def _fleet_followers(root: str) -> List[tuple]:
     """[(replica_name, JsonlFollower)] over every per-replica run dir
-    under ``root`` (discovered once at startup via the same filter
-    ``obs summarize --fleet`` uses; a fleet's membership is fixed for
-    the life of one `fleet up`)."""
+    under ``root`` (the same filter ``obs summarize --fleet`` uses),
+    plus the ``#autoscale`` control stream (``<root>/autoscale.jsonl``,
+    which may not exist yet — the follower retries silently). The tail
+    loop re-runs this discovery every poll: an autoscaled fleet grows
+    new replica dirs mid-follow."""
     from .report import fleet_replica_dirs
 
     pairs = []
     for name, sub in fleet_replica_dirs(root):
         for p in _follow_paths(sub):
             pairs.append((name, JsonlFollower(p)))
+    pairs.append(("#autoscale",
+                  JsonlFollower(os.path.join(root, "autoscale.jsonl"))))
     return pairs
 
 
@@ -245,6 +309,13 @@ def tail(path: str, interval_s: float = 1.0,
                 if max_seconds is not None else None)
     last_line = None
     while True:
+        if fleet:
+            # Membership can change under a live follow (autoscale):
+            # pick up newly created replica dirs each poll.
+            known = {f.path for _, f in pairs}
+            for name, f in _fleet_followers(path):
+                if f.path not in known:
+                    pairs.append((name, f))
         for name, f in pairs:
             for rec in f.poll():
                 def _fold(r):

@@ -1,0 +1,424 @@
+"""fleet/autoscale.py tests: pool-signal folding, policy validation,
+hysteresis streaks + cooldown, phase-aware pools, victim selection, the
+zero-drop drain contract, decision determinism, and the supervised
+spawner — all over scripted engines on an injected clock, no JAX and no
+wall time anywhere.
+"""
+
+import sys
+
+import pytest
+
+from deeplearning_cfn_tpu.fleet import (
+    AutoscalePolicy,
+    Autoscaler,
+    EngineReplica,
+    ReplicaProcSpec,
+    Router,
+    SupervisedSpawner,
+    pool_signals,
+)
+from deeplearning_cfn_tpu.obs.signals import SignalBus
+from deeplearning_cfn_tpu.serve.queue import (
+    OverloadError,
+    Request,
+    RequestState,
+)
+
+
+# -- fakes (scripted engine, same shape as tests/test_fleet.py) --------------
+
+
+class _FakeQueue:
+    def __init__(self, max_depth):
+        self.max_depth = max_depth
+        self.items = []
+
+    @property
+    def depth(self):
+        return len(self.items)
+
+
+class _FakeMetrics:
+    def __init__(self):
+        self.step_latency_s = []
+        self.tokens_generated = 0
+        self.last_retry_after_s = None
+
+
+class FakeEngine:
+    def __init__(self, capacity=2, queue_depth=8, work=1, phase="both"):
+        self.capacity = capacity
+        self.queue = _FakeQueue(queue_depth)
+        self.metrics = _FakeMetrics()
+        self.work = work
+        self.phase = phase
+        self.variables = {"params": "v0"}
+        self._running = {}
+        self._by_id = {}
+
+    @property
+    def active_requests(self):
+        return len(self._running)
+
+    def submit(self, src_ids, max_new_tokens=None, beam_size=1,
+               deadline_s=None, request_id=None, trace_id=None):
+        if self.queue.depth >= self.queue.max_depth:
+            raise OverloadError(self.queue.depth, self.queue.max_depth)
+        rid = request_id if request_id is not None \
+            else f"fake-{len(self._by_id)}"
+        req = Request(id=rid, src_ids=list(src_ids),
+                      max_new_tokens=max_new_tokens or 4,
+                      beam_size=beam_size, trace_id=trace_id)
+        self.queue.items.append(req)
+        self._by_id[rid] = req
+        return req
+
+    def poll(self, request_id):
+        if request_id not in self._by_id:
+            raise KeyError(request_id)
+        return self._by_id[request_id]
+
+    def cancel(self, request_id):
+        req = self.poll(request_id)
+        if req.finished:
+            return False
+        req.state = RequestState.CANCELLED
+        if req in self.queue.items:
+            self.queue.items.remove(req)
+        self._running.pop(req.id, None)
+        return True
+
+    def step(self):
+        while self.queue.items and len(self._running) < self.capacity:
+            req = self.queue.items.pop(0)
+            if req.finished:
+                continue
+            req.state = RequestState.RUNNING
+            self._running[req.id] = self.work
+        decoded = 0
+        for rid in list(self._running):
+            req = self._by_id[rid]
+            self._running[rid] -= 1
+            req.tokens.append(1)
+            decoded += 1
+            self.metrics.tokens_generated += 1
+            if self._running[rid] <= 0:
+                req.state = RequestState.DONE
+                req.finished_at = 0.0
+                del self._running[rid]
+        return decoded
+
+
+def _replica(rid, **kw):
+    return EngineReplica(rid, FakeEngine(**kw))
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 0.0
+
+    def read(self):
+        return self.now
+
+
+class _Spawner:
+    """Callable spawner that also records retire() calls."""
+
+    def __init__(self, **engine_kw):
+        self.engine_kw = engine_kw
+        self.spawned = []
+        self.retired = []
+
+    def spawn(self, phase, rid):
+        self.spawned.append(rid)
+        return _replica(rid, phase=phase, **self.engine_kw)
+
+    def retire(self, rid):
+        self.retired.append(rid)
+
+
+def _feed(bus, router, depths):
+    """Push one queue-depth observation per replica into the bus."""
+    for rid, depth in depths.items():
+        if rid in router.replica_ids():
+            bus.observe(rid, {"serve_queue_depth": depth})
+
+
+def _scaler(replicas=1, policy=None, **kw):
+    reps = [_replica(f"replica-{i}", queue_depth=64)
+            for i in range(replicas)]
+    router = Router(reps, policy="round_robin")
+    bus = SignalBus(names=[r.id for r in reps])
+    clock = _Clock()
+    spawner = _Spawner(queue_depth=64)
+    scaler = Autoscaler(router, bus, spawner,
+                        policy=policy or AutoscalePolicy(**kw),
+                        clock=clock.read)
+    return scaler, router, bus, clock, spawner
+
+
+# -- pool signals ------------------------------------------------------------
+
+
+def test_pool_signals_null_over_zero_and_extrema():
+    bus = SignalBus(names=["a", "b", "c"])
+    bus.observe("a", {"serve_queue_depth": 3,
+                      "serve_latency_p95_s": 0.2,
+                      "serve_spec_accept_rate": 0.9})
+    bus.observe("b", {"serve_queue_depth": 1,
+                      "serve_latency_p95_s": 0.7,
+                      "serve_retry_after_hint_s": 0.4,
+                      "serve_spec_accept_rate": 0.5})
+    sig = pool_signals(bus, ["a", "b", "c"])
+    assert sig["members_reporting"] == 3
+    assert sig["queue_depth"] == 4               # sum
+    assert sig["worst_latency_p95_s"] == 0.7     # max
+    assert sig["retry_after_pressure_s"] == 0.4  # max of reporters
+    assert sig["spec_accept_rate_min"] == 0.5    # min
+    # A pool nobody reported into is all-None, never all-zero.
+    empty = pool_signals(bus, ["nope"])
+    assert empty["members_reporting"] == 0
+    assert empty["queue_depth"] is None
+    # Pool slicing: a's signals only.
+    assert pool_signals(bus, ["a"])["queue_depth"] == 3
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(up_queue_depth=0.5, down_queue_depth=0.5)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(up_stable_ticks=0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(cooldown_s=-1.0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(drain_grace_ticks=0)
+
+
+# -- hysteresis / cooldown ---------------------------------------------------
+
+
+def test_scale_up_needs_a_breach_streak():
+    scaler, router, bus, clock, _ = _scaler(
+        up_stable_ticks=2, cooldown_s=0.0)
+    # One spiky tick does not scale...
+    _feed(bus, router, {"replica-0": 10})
+    assert scaler.tick() == []
+    # ...a calm tick resets the streak...
+    _feed(bus, router, {"replica-0": 0})
+    assert scaler.tick() == []
+    _feed(bus, router, {"replica-0": 10})
+    assert scaler.tick() == []
+    # ...two consecutive breaches fire exactly one scale-up.
+    _feed(bus, router, {"replica-0": 10})
+    evs = scaler.tick()
+    assert [e["action"] for e in evs] == ["scale_up"]
+    assert evs[0]["replica"] == "auto-both-0"
+    assert "queue_depth" in evs[0]["reason"]
+    assert "auto-both-0" in router.replica_ids()
+    assert scaler.state() == "scaling-up"
+
+
+def test_cooldown_blocks_consecutive_actions():
+    scaler, router, bus, clock, _ = _scaler(
+        up_stable_ticks=1, cooldown_s=5.0, max_replicas=4)
+    _feed(bus, router, {"replica-0": 50})
+    assert len(scaler.tick()) == 1
+    # Still breaching, but inside the cooldown window: no action.
+    for _ in range(3):
+        clock.now += 1.0
+        _feed(bus, router, {r: 50 for r in router.replica_ids()})
+        assert scaler.tick() == []
+    clock.now += 5.0             # past the cooldown -> next action fires
+    _feed(bus, router, {r: 50 for r in router.replica_ids()})
+    assert [e["action"] for e in scaler.tick()] == ["scale_up"]
+
+
+def test_scale_up_respects_max_replicas():
+    scaler, router, bus, clock, _ = _scaler(
+        up_stable_ticks=1, cooldown_s=0.0, max_replicas=2)
+    _feed(bus, router, {"replica-0": 50})
+    assert len(scaler.tick()) == 1
+    clock.now += 1.0
+    _feed(bus, router, {r: 50 for r in router.replica_ids()})
+    assert scaler.tick() == []          # at the ceiling
+    assert len(router.replica_ids()) == 2
+
+
+def test_drain_based_scale_down_zero_drop():
+    scaler, router, bus, clock, spawner = _scaler(
+        up_stable_ticks=1, down_stable_ticks=2, cooldown_s=0.0)
+    _feed(bus, router, {"replica-0": 50})
+    scaler.tick()
+    assert "auto-both-0" in router.replica_ids()
+    # Put live work on the spawned replica, then go calm: the drain
+    # begins but removal waits for idleness.
+    rid = router.submit([5, 4, 3], max_new_tokens=3)
+    while router._requests[rid].replica_id != "auto-both-0":
+        rid = router.submit([5, 4, 3], max_new_tokens=3)
+    for _ in range(2):
+        clock.now += 0.1
+        _feed(bus, router, {r: 0 for r in router.replica_ids()})
+        evs = scaler.tick()
+    assert [e["action"] for e in evs] == ["drain_begin"]
+    assert evs[0]["replica"] == "auto-both-0"
+    assert scaler.state() == "draining"
+    assert scaler.draining == ["auto-both-0"]
+    # Busy victim: tick after tick, still a member.
+    clock.now += 0.1
+    assert scaler.tick() == []
+    assert "auto-both-0" in router.replica_ids()
+    # Let the work finish, then the drain completes as a removal.
+    router.run_until_drained()
+    clock.now += 0.1
+    evs = scaler.tick()
+    assert [e["action"] for e in evs] == ["scale_down"]
+    assert evs[0]["drained"] is True
+    assert "auto-both-0" not in router.replica_ids()
+    assert spawner.retired == ["auto-both-0"]
+    assert scaler.state() == "steady"
+    # Zero-drop, and every submitted request completed whole.
+    assert router.stats()["dropped_requests"] == 0
+    assert router.result(rid)["state"] == "done"
+
+
+def test_drain_grace_expiry_evacuates_not_drops():
+    scaler, router, bus, clock, _ = _scaler(
+        up_stable_ticks=1, down_stable_ticks=1, cooldown_s=0.0,
+        drain_grace_ticks=2)
+    _feed(bus, router, {"replica-0": 50})
+    scaler.tick()
+    # Pin unfinished work on the victim (never stepped to completion).
+    rid = router.submit([5, 4, 3], max_new_tokens=50)
+    while router._requests[rid].replica_id != "auto-both-0":
+        rid = router.submit([5, 4, 3], max_new_tokens=50)
+    clock.now += 1.0
+    _feed(bus, router, {r: 0 for r in router.replica_ids()})
+    evs = scaler.tick()
+    assert [e["action"] for e in evs] == ["drain_begin"]
+    # Grace of 2 ticks expires with the victim still busy: the work is
+    # evacuated to survivors, the removal records drained=False.
+    down = []
+    for _ in range(3):
+        clock.now += 0.1
+        down.extend(e for e in scaler.tick()
+                    if e["action"] == "scale_down")
+    assert len(down) == 1
+    assert down[0]["drained"] is False
+    assert "evacuated" in down[0]["reason"]
+    assert "auto-both-0" not in router.replica_ids()
+    assert router.stats()["dropped_requests"] == 0
+    # The evacuated request lives on and completes elsewhere.
+    router.run_until_drained()
+    assert router.result(rid)["state"] == "done"
+
+
+def test_scale_down_respects_min_replicas():
+    scaler, router, bus, clock, _ = _scaler(
+        replicas=1, down_stable_ticks=1, cooldown_s=0.0)
+    for _ in range(5):
+        clock.now += 1.0
+        _feed(bus, router, {"replica-0": 0})
+        assert scaler.tick() == []      # already at min_replicas=1
+    assert router.replica_ids() == ["replica-0"]
+
+
+def test_victim_selection_prefers_newest_spawned():
+    scaler, router, bus, clock, _ = _scaler(
+        up_stable_ticks=1, down_stable_ticks=1, cooldown_s=0.0,
+        max_replicas=3)
+    for _ in range(2):
+        clock.now += 1.0
+        _feed(bus, router, {r: 50 for r in router.replica_ids()})
+        scaler.tick()
+    assert sorted(router.replica_ids()) == [
+        "auto-both-0", "auto-both-1", "replica-0"]
+    clock.now += 1.0
+    _feed(bus, router, {r: 0 for r in router.replica_ids()})
+    evs = scaler.tick()
+    # LIFO: the NEWEST spawn drains first; the operator's seed replica
+    # is never chosen while a spawned one remains.
+    assert evs[0]["action"] == "drain_begin"
+    assert evs[0]["replica"] == "auto-both-1"
+
+
+# -- phase-aware pools -------------------------------------------------------
+
+
+def test_pools_scale_independently_by_phase():
+    reps = [EngineReplica("prefill-0",
+                          FakeEngine(queue_depth=64, phase="prefill")),
+            EngineReplica("decode-0",
+                          FakeEngine(queue_depth=64, phase="decode"))]
+    router = Router(reps, policy="round_robin")
+    bus = SignalBus(names=[r.id for r in reps])
+    clock = _Clock()
+    spawner = _Spawner(queue_depth=64)
+    scaler = Autoscaler(router, bus, spawner,
+                        policy=AutoscalePolicy(up_stable_ticks=1,
+                                               cooldown_s=0.0),
+                        clock=clock.read)
+    assert scaler.phases() == ["decode", "prefill"]
+    # Pressure ONLY on the prefill pool.
+    bus.observe("prefill-0", {"serve_queue_depth": 50})
+    bus.observe("decode-0", {"serve_queue_depth": 0})
+    evs = scaler.tick()
+    assert [(e["action"], e["phase"]) for e in evs] == [
+        ("scale_up", "prefill")]
+    assert evs[0]["replica"] == "auto-prefill-0"
+    assert router.replica("auto-prefill-0").phase == "prefill"
+    assert scaler.pool_members("decode") == ["decode-0"]
+    # Per-phase state: prefill scaling-up, decode steady.
+    assert scaler.state("prefill") == "scaling-up"
+    assert scaler.state("decode") == "steady"
+
+
+# -- determinism -------------------------------------------------------------
+
+
+def test_decision_sequence_is_deterministic():
+    def _run():
+        scaler, router, bus, clock, _ = _scaler(
+            up_stable_ticks=2, down_stable_ticks=3, cooldown_s=0.5)
+        script = [8, 8, 8, 8, 0, 0, 0, 0, 0, 0, 6, 6, 6]
+        for depth in script:
+            clock.now += 0.25
+            _feed(bus, router, {r: depth for r in router.replica_ids()})
+            scaler.tick()
+            router.step()
+        return scaler.events
+
+    a, b = _run(), _run()
+    assert a == b
+    assert [e["action"] for e in a].count("scale_up") >= 1
+
+
+# -- supervised spawner ------------------------------------------------------
+
+
+def test_supervised_spawner_runs_one_supervisor_per_spawn(tmp_path):
+    def spec_factory(phase, rid):
+        return ReplicaProcSpec(
+            replica_id=rid,
+            argv=[sys.executable, "-c", "import time; time.sleep(60)"],
+            run_dir=str(tmp_path / rid))
+
+    spawner = SupervisedSpawner(spec_factory,
+                                lambda phase, rid: _replica(
+                                    rid, phase=phase))
+    rep = spawner.spawn("both", "auto-both-0")
+    assert rep.id == "auto-both-0"
+    sup = spawner.supervisors["auto-both-0"]
+    assert [row["replica"] for row in sup.status()] == ["auto-both-0"]
+    # Retire terminates and forgets the supervisor; idempotent.
+    spawner.retire("auto-both-0")
+    assert spawner.supervisors == {}
+    spawner.retire("auto-both-0")
+    # close() retires whatever is left.
+    spawner.spawn("both", "auto-both-1")
+    spawner.close()
+    assert spawner.supervisors == {}

@@ -230,6 +230,69 @@ def test_least_loaded_ties_break_by_step_latency():
     assert _placements(router, [rid]) == ["replica-1"]
 
 
+def test_round_robin_stable_under_membership_churn():
+    """The autoscaler interleaves add()/remove() with live submissions;
+    the id-cursor must keep a fair rotation across every churn — never
+    double-placing one replica in a window or skipping a live one."""
+    reps = {f"replica-{i}": _fake_replica(f"replica-{i}", capacity=8,
+                                          queue_depth=64)
+            for i in range(3)}
+    router = Router([reps["replica-0"], reps["replica-1"]],
+                    policy="round_robin")
+
+    def place(n):
+        return _placements(router,
+                           [router.submit([5, 4, 3]) for _ in range(n)])
+
+    assert place(3) == ["replica-0", "replica-1", "replica-0"]
+    # Join mid-rotation (cursor sits at replica-0): the newcomer enters
+    # exactly where its id sorts, nobody is double-placed.
+    router.add(reps["replica-2"])
+    assert place(3) == ["replica-1", "replica-2", "replica-0"]
+    # Drain in-flight work so removal is churn, not evacuation.
+    router.run_until_drained()
+    router.remove("replica-1")
+    assert place(3) == ["replica-2", "replica-0", "replica-2"]
+    # Re-admission mid-stream: same total order, no skip on the wrap.
+    router.run_until_drained()
+    router.add(_fake_replica("replica-1", capacity=8, queue_depth=64))
+    assert place(4) == ["replica-0", "replica-1", "replica-2",
+                        "replica-0"]
+    # Removing the replica the cursor points AT: rotation resumes at
+    # the next id above the stale cursor, deterministically.
+    router.run_until_drained()
+    router.remove("replica-0")
+    assert place(3) == ["replica-1", "replica-2", "replica-1"]
+    assert router.stats()["dropped_requests"] == 0
+
+
+def test_least_loaded_stable_under_membership_churn():
+    """least_loaded under churn: a newcomer (emptiest) wins the next
+    placement, and evacuation off a removed member re-places onto the
+    emptiest CURRENT member — membership is read live, never cached."""
+    reps = {f"replica-{i}": _fake_replica(f"replica-{i}", capacity=8,
+                                          queue_depth=64)
+            for i in range(3)}
+    router = Router([reps["replica-0"], reps["replica-1"]],
+                    policy="least_loaded")
+    a = router.submit([5, 4, 3])
+    b = router.submit([5, 4, 3])
+    assert _placements(router, [a, b]) == ["replica-0", "replica-1"]
+    router.add(reps["replica-2"])
+    c = router.submit([5, 4, 3])         # newcomer is emptiest
+    d = router.submit([5, 4, 3])         # tie at 1 each -> lowest id
+    assert _placements(router, [c, d]) == ["replica-2", "replica-0"]
+    # Remove the newcomer while its request is still queued: the
+    # evacuated copy lands on the emptiest survivor (replica-1 at 1,
+    # vs replica-0 at 2), not on a stale view that includes replica-2.
+    router.remove("replica-2")
+    assert _placements(router, [c]) == ["replica-1"]
+    router.run_until_drained()
+    assert all(router.result(r)["state"] == "done"
+               for r in (a, b, c, d))
+    assert router.stats()["dropped_requests"] == 0
+
+
 # -- shedding / overload -----------------------------------------------------
 
 
@@ -793,6 +856,50 @@ def test_fleet_bench_smoke_contract_record():
     assert rec["goodput_tokens_per_sec"] is not None
     assert rec["goodput_tokens_per_sec"] > 0
     assert json.dumps(rec)   # one JSON line, like every bench record
+
+
+def test_fleet_bench_autoscale_burst_contract():
+    """The acceptance scenario end to end: `bench --fleet --trace burst
+    --autoscale` scales up at burst onset, scales down by drain at the
+    trough, drops nothing, stays token-identical to a fixed-size fleet,
+    and is fully deterministic across runs."""
+    from deeplearning_cfn_tpu.fleet.bench import run_fleet_bench
+
+    kw = dict(smoke=True, autoscale=True, trace_spec="burst",
+              policy="round_robin")
+    rec = run_fleet_bench(**kw)
+    assert rec["autoscale"] is True
+    assert rec["trace_spec"].startswith("burst")
+    assert rec["dropped_requests"] == 0
+    assert rec["token_identical"] is True          # vs FIXED max fleet
+    assert rec["scale_ups"] >= 1
+    first_up = next(e for e in rec["scale_events"]
+                    if e["action"] == "scale_up")
+    assert first_up["replica"].startswith("auto-")
+    assert first_up["reason"]
+    assert first_up["signals"]["queue_depth"] is not None
+    downs = [e for e in rec["scale_events"]
+             if e["action"] == "scale_down"]
+    assert downs and all(e["drained"] is True for e in downs)
+    # Scale-up at burst onset: well under a virtual second from the
+    # first arrival.
+    assert rec["time_to_scale_s"] is not None
+    assert 0.0 <= rec["time_to_scale_s"] < 1.0
+    assert rec["p95_during_burst"] is not None
+    assert rec["offered_load_rps"] > 0
+    assert rec["replicas_initial"] == rec["min_replicas"] == 1
+    assert rec["replicas_final"] == 1              # drained to trough
+    assert rec["max_replicas"] >= 2
+    # Events are ordered on the virtual clock and phase-consistent.
+    ts = [e["ts"] for e in rec["scale_events"]]
+    assert ts == sorted(ts)
+    assert json.dumps(rec)
+    # Determinism: identical arrival schedule AND scale decisions.
+    rec2 = run_fleet_bench(**kw)
+    assert rec2["arrival_schedule"] == rec["arrival_schedule"]
+    assert rec2["scale_events"] == rec["scale_events"]
+    assert [r["tokens"] for r in rec2["per_replica"]] == \
+        [r["tokens"] for r in rec["per_replica"]]
 
 
 # -- request tracing & the goodput ledger ------------------------------------

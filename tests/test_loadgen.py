@@ -1,0 +1,331 @@
+"""loadgen/ subsystem tests: seeded arrival processes, trace-spec
+parsing, deterministic schedule generation (class mixes, budgets,
+prefix-sharing groups), and the open-loop replay loop against a
+scripted router — no JAX, no wall clock anywhere.
+"""
+
+import pytest
+
+from deeplearning_cfn_tpu.loadgen import (
+    LoadGenerator,
+    RequestClass,
+    TraceSpec,
+    VirtualClock,
+    bursty_arrivals,
+    diurnal_arrivals,
+    parse_trace_spec,
+    poisson_arrivals,
+    replay,
+)
+from deeplearning_cfn_tpu.serve.queue import OverloadError
+
+
+# -- arrival processes -------------------------------------------------------
+
+
+def test_poisson_arrivals_seeded_and_sorted():
+    a = poisson_arrivals(10.0, 5.0, seed=7)
+    b = poisson_arrivals(10.0, 5.0, seed=7)
+    assert a == b                       # same seed, same draw — exactly
+    assert a != poisson_arrivals(10.0, 5.0, seed=8)
+    assert all(0.0 <= t < 5.0 for t in a)
+    assert a == sorted(a)
+    # An exponential(10/s) draw over 5s lands near 50 arrivals; the
+    # band is wide on purpose (this is a distribution check, not a
+    # regression pin).
+    assert 20 <= len(a) <= 90
+
+
+def test_poisson_arrivals_validation():
+    # Zero rate or duration is a legitimate empty schedule; negatives
+    # are a caller bug.
+    assert poisson_arrivals(0.0, 5.0) == []
+    assert poisson_arrivals(10.0, 0.0) == []
+    with pytest.raises(ValueError):
+        poisson_arrivals(-1.0, 5.0)
+    with pytest.raises(ValueError):
+        poisson_arrivals(10.0, -1.0)
+    with pytest.raises(ValueError):
+        bursty_arrivals(5.0, 1.0, 0.0, 0.5, 2.0)    # burst < base
+    with pytest.raises(ValueError):
+        diurnal_arrivals(5.0, 1.0, 4.0, 4.0)        # peak < trough
+    with pytest.raises(ValueError):
+        diurnal_arrivals(0.0, 1.0, 0.0, 4.0)        # period <= 0
+
+
+def test_bursty_arrivals_concentrate_in_window():
+    times = bursty_arrivals(base_rps=1.0, burst_rps=100.0,
+                            burst_start_s=2.0, burst_s=0.5,
+                            duration_s=5.0, seed=3)
+    inside = [t for t in times if 2.0 <= t < 2.5]
+    outside = [t for t in times if not 2.0 <= t < 2.5]
+    assert len(inside) > len(outside)   # 50 expected in vs ~4.5 out
+    assert times == bursty_arrivals(1.0, 100.0, 2.0, 0.5, 5.0, seed=3)
+
+
+def test_diurnal_arrivals_peak_beats_trough():
+    # One full period: the middle (peak of the raised cosine) must carry
+    # more arrivals than the edges (trough).
+    times = diurnal_arrivals(trough_rps=0.5, peak_rps=40.0,
+                             period_s=6.0, duration_s=6.0, seed=5)
+    mid = [t for t in times if 2.0 <= t < 4.0]
+    edges = [t for t in times if t < 2.0 or t >= 4.0]
+    assert len(mid) > len(edges)
+    assert times == diurnal_arrivals(0.5, 40.0, 6.0, 6.0, seed=5)
+
+
+# -- spec parsing ------------------------------------------------------------
+
+
+def test_parse_trace_spec_presets_scale_off_bench_dims():
+    spec = parse_trace_spec("burst", src_len=8, max_new_tokens=4,
+                            requests=6)
+    assert spec.process == "burst"
+    assert spec.max_requests == 6
+    assert spec.param("burst_s") == 0.1
+    assert spec.param("rate") == 2.0 * 6 / 0.1     # oversample then cap
+    assert spec.hot_window() == (0.0, pytest.approx(0.1))
+    assert len(spec.classes) == 1
+    assert spec.classes[0].src_len == 8
+    assert spec.classes[0].max_new_tokens == 4
+
+
+def test_parse_trace_spec_overrides_and_mix():
+    spec = parse_trace_spec(
+        "poisson:rate=3,duration=10,requests=5,mix=prefill-heavy",
+        src_len=9, max_new_tokens=6)
+    assert spec.param("rate") == 3.0
+    assert spec.duration_s == 10.0
+    assert spec.max_requests == 5
+    names = [c.name for c in spec.classes]
+    assert names == ["adversary", "stream"]
+    adversary = spec.classes[0]
+    assert adversary.src_len == 9 and adversary.max_new_tokens == 2
+
+
+def test_parse_trace_spec_prefix_groups():
+    spec = parse_trace_spec("poisson:prefix_groups=2", src_len=8)
+    cls = spec.classes[0]
+    assert cls.prefix_groups == 2
+    assert cls.prefix_len == 4          # default src_len // 2
+
+
+def test_parse_trace_spec_rejects_bad_input():
+    with pytest.raises(ValueError):
+        parse_trace_spec("")
+    with pytest.raises(ValueError):
+        parse_trace_spec("lognormal")           # unknown preset
+    with pytest.raises(ValueError):
+        parse_trace_spec("poisson:peak=3")      # key from another preset
+    with pytest.raises(ValueError):
+        parse_trace_spec("poisson:rate")        # not key=value
+    with pytest.raises(ValueError):
+        parse_trace_spec("poisson:rate=fast")   # not a number
+    with pytest.raises(ValueError):
+        parse_trace_spec("poisson:mix=spicy")   # unknown mix
+    with pytest.raises(ValueError):
+        parse_trace_spec("poisson:requests=0")
+
+
+def test_request_class_and_spec_validation():
+    with pytest.raises(ValueError):
+        RequestClass("c", src_len=0, max_new_tokens=4)
+    with pytest.raises(ValueError):
+        RequestClass("c", src_len=4, max_new_tokens=4, weight=0.0)
+    with pytest.raises(ValueError):
+        RequestClass("c", src_len=4, max_new_tokens=4,
+                     prefix_groups=2, prefix_len=9)   # > src_len
+    with pytest.raises(ValueError):
+        TraceSpec(name="x", process="sawtooth", duration_s=1.0,
+                  max_requests=1, params=(),
+                  classes=(RequestClass("c", 4, 4),))
+    with pytest.raises(ValueError):
+        TraceSpec(name="x", process="poisson", duration_s=1.0,
+                  max_requests=1, params=(("rate", 1.0),), classes=())
+
+
+# -- schedule generation -----------------------------------------------------
+
+
+def _spec(**over):
+    kw = dict(name="t", process="poisson", duration_s=4.0,
+              max_requests=12, params=(("rate", 10.0),),
+              classes=(RequestClass("base", src_len=6,
+                                    max_new_tokens=3),))
+    kw.update(over)
+    return TraceSpec(**kw)
+
+
+def test_schedule_deterministic_and_seed_sensitive():
+    a = LoadGenerator(_spec(), seed=1).schedule
+    b = LoadGenerator(_spec(), seed=1).schedule
+    assert a == b
+    assert a != LoadGenerator(_spec(), seed=2).schedule
+    assert [s.request_id for s in a] == [f"lg-{i:04d}"
+                                         for i in range(len(a))]
+    assert all(len(s.src_ids) == 6 and s.max_new_tokens == 3 for s in a)
+    # Prompt tokens stay inside the vocab, above the reserved ids.
+    assert all(3 <= t < 96 for s in a for t in s.src_ids)
+
+
+def test_schedule_honors_class_budgets():
+    spec = _spec(classes=(
+        RequestClass("capped", src_len=4, max_new_tokens=2, budget=2),
+        RequestClass("open", src_len=4, max_new_tokens=2),
+    ))
+    sched = LoadGenerator(spec, seed=0).schedule
+    counts = {}
+    for s in sched:
+        counts[s.cls] = counts.get(s.cls, 0) + 1
+    assert counts.get("capped", 0) <= 2
+    # When EVERY budget is exhausted the schedule ends early instead of
+    # mislabeling arrivals.
+    allcapped = _spec(classes=(
+        RequestClass("a", src_len=4, max_new_tokens=2, budget=1),
+        RequestClass("b", src_len=4, max_new_tokens=2, budget=2),
+    ))
+    sched = LoadGenerator(allcapped, seed=0).schedule
+    assert len(sched) == 3
+
+
+def test_schedule_prefix_groups_share_prefixes():
+    spec = _spec(classes=(RequestClass(
+        "base", src_len=8, max_new_tokens=2, prefix_groups=2,
+        prefix_len=4),))
+    sched = LoadGenerator(spec, seed=0).schedule
+    assert len(sched) >= 4
+    by_group = {}
+    for s in sched:
+        by_group.setdefault(s.prefix_group, []).append(s.src_ids[:4])
+    assert set(by_group) == {"base/g0", "base/g1"}
+    for group, prefixes in by_group.items():
+        assert len(set(prefixes)) == 1       # shared within a group
+    assert by_group["base/g0"][0] != by_group["base/g1"][0]
+
+
+def test_schedule_prompt_corpus_replaces_random_prompts():
+    corpus = [[10, 11, 12, 13, 14, 15, 16, 17], [20, 21, 22, 23]]
+    spec = _spec(classes=(RequestClass("base", src_len=4,
+                                       max_new_tokens=2),))
+    sched = LoadGenerator(spec, seed=0, prompt_corpus=corpus).schedule
+    assert list(sched[0].src_ids) == [10, 11, 12, 13]   # truncated
+    assert list(sched[1].src_ids) == [20, 21, 22, 23]
+    assert list(sched[2].src_ids) == [10, 11, 12, 13]   # wraps
+    with pytest.raises(ValueError):
+        LoadGenerator(spec, seed=0, prompt_corpus=[[]])
+    with pytest.raises(ValueError):
+        LoadGenerator(spec, vocab_size=3)    # vocab <= reserved
+
+
+# -- virtual clock -----------------------------------------------------------
+
+
+def test_virtual_clock_only_moves_forward():
+    c = VirtualClock()
+    assert c.read() == 0.0
+    assert c.advance(0.25) == 0.25
+    assert c.read() == 0.25
+    with pytest.raises(ValueError):
+        c.advance(-0.1)
+
+
+# -- replay against a scripted router ----------------------------------------
+
+
+class _ScriptedRouter:
+    """Router lookalike: admits up to ``capacity`` concurrent requests,
+    each finishing after ``work`` steps; rejections carry a fixed
+    retry-after hint. Records every submission timestamp via the shared
+    clock so the test can assert the hint was honored."""
+
+    def __init__(self, clock, capacity=2, work=1, retry_after=None):
+        self.clock = clock
+        self.capacity = capacity
+        self.work = work
+        self.retry_after = retry_after
+        self.running = {}
+        self.done = set()
+        self.ledger = {}
+        self.submissions = []
+
+    def submit(self, src_ids, max_new_tokens=None, request_id=None):
+        if len(self.running) >= self.capacity:
+            raise OverloadError(len(self.running), self.capacity,
+                                retry_after_s=self.retry_after)
+        self.submissions.append((request_id, self.clock.read()))
+        self.running[request_id] = self.work
+        self.ledger[request_id] = {"e2e_s": None}
+        return request_id
+
+    def step(self):
+        for rid in list(self.running):
+            self.running[rid] -= 1
+            if self.running[rid] <= 0:
+                del self.running[rid]
+                self.done.add(rid)
+        return len(self.done)
+
+    def pending(self):
+        return len(self.running)
+
+
+def test_replay_open_loop_admits_everything_and_stays_virtual():
+    spec = _spec(max_requests=6)
+    gen = LoadGenerator(spec, seed=0)
+    clock = VirtualClock()
+    router = _ScriptedRouter(clock, capacity=100)
+    report = replay(gen, router, clock, tick_s=0.05)
+    assert [rid for rid, _ in router.submissions] == report.rids
+    assert report.rejections == 0
+    assert all(o["outcome"] == "admitted"
+               for o in report.outcomes.values())
+    # Open loop: the replay runs to the spec duration even after the
+    # work drains, and offered load is schedule/duration — independent
+    # of service speed.
+    assert report.duration_s >= spec.duration_s
+    assert report.offered_load_rps == \
+        pytest.approx(len(gen.schedule) / spec.duration_s)
+    # Outcomes folded into the router's ledger under "loadgen".
+    assert all("loadgen" in router.ledger[rid] for rid in report.rids)
+
+
+def test_replay_honors_retry_after_hint_and_drops_nothing():
+    spec = _spec(max_requests=8)
+    gen = LoadGenerator(spec, seed=0)
+    clock = VirtualClock()
+    router = _ScriptedRouter(clock, capacity=1, work=3,
+                             retry_after=0.3)
+    report = replay(gen, router, clock, tick_s=0.05)
+    assert report.rejections > 0
+    assert report.retries_honored > 0
+    retried = [o for o in report.outcomes.values() if o["rejections"]]
+    assert retried
+    assert all(o["outcome"] == "admitted_after_retry" for o in retried)
+    assert all(o["retry_after_honored"] for o in retried)
+    # Zero-drop: every scheduled request was eventually admitted.
+    assert set(rid for rid, _ in router.submissions) == set(report.rids)
+    # The hint is real backoff: a rejected request's actual submission
+    # comes at least retry_after after its scheduled arrival.
+    sub_ts = dict(router.submissions)
+    for rid, o in report.outcomes.items():
+        if o["rejections"]:
+            assert sub_ts[rid] >= o["scheduled_s"] + 0.3 - 1e-9
+
+
+def test_replay_deterministic_end_to_end():
+    def _run():
+        gen = LoadGenerator(_spec(max_requests=8), seed=4)
+        clock = VirtualClock()
+        router = _ScriptedRouter(clock, capacity=1, work=2,
+                                 retry_after=0.2)
+        report = replay(gen, router, clock, tick_s=0.05)
+        return router.submissions, report.outcomes, report.ticks
+
+    assert _run() == _run()
+
+
+def test_replay_validates_tick():
+    gen = LoadGenerator(_spec(max_requests=2), seed=0)
+    with pytest.raises(ValueError):
+        replay(gen, _ScriptedRouter(VirtualClock()), VirtualClock(),
+               tick_s=0.0)

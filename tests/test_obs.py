@@ -1368,3 +1368,166 @@ def test_signal_bus_sequences_records_without_timestamps():
     assert win["samples"] == 1
     assert win["window_start_ts"] == 1.0            # seq counter stands in
     assert win["last"] == 3
+
+
+def test_signal_bus_membership_churn_mid_window():
+    """The autoscaler adds/removes replicas while the bus is live: a
+    joiner registers on first observe and lands in the aggregate
+    immediately, without disturbing the incumbents' rolling windows; a
+    leaver simply stops reporting (its last values persist — the bus is
+    an observer, not the membership authority, which is the router)."""
+    from deeplearning_cfn_tpu.obs.signals import SignalBus
+
+    bus = SignalBus(names=["replica-0"])
+    bus.observe("replica-0", {"ts": 1.0, "serve_queue_depth": 3,
+                              "serve_tokens_per_sec": 10.0})
+    before = bus.replica("replica-0").snapshot()["windowed"]["queue_depth"]
+    # Join mid-window: unknown name auto-registers on first observe.
+    bus.observe("auto-both-0", {"ts": 1.5, "serve_queue_depth": 2,
+                                "serve_tokens_per_sec": 4.0})
+    f = bus.fleet()
+    assert f["replicas"] == 2 and f["replicas_live"] == 2
+    assert f["queue_depth"] == 5          # joiner counted immediately
+    assert f["tokens_per_sec"] == 14.0
+    after = bus.replica("replica-0").snapshot()["windowed"]["queue_depth"]
+    assert after == before                # incumbent fold untouched
+    # The incumbent keeps folding into the SAME window after the join.
+    bus.observe("replica-0", {"ts": 2.0, "serve_queue_depth": 1})
+    win = bus.replica("replica-0").snapshot()["windowed"]["queue_depth"]
+    assert win["samples"] == before["samples"] + 1
+    assert win["last"] == 1
+    # Leave: the joiner drains away and stops reporting; the aggregate
+    # still sums its last-known values (staleness is visible in ts, not
+    # silently zeroed) and stays JSON-serializable.
+    bus.observe("replica-0", {"ts": 3.0, "serve_queue_depth": 0})
+    f = bus.fleet()
+    assert f["queue_depth"] == 2          # 0 + joiner's last 2
+    assert json.dumps(bus.snapshot())
+
+
+def test_signal_bus_churn_replay_determinism():
+    """Folding the same churn sequence twice — registration order,
+    joins, and all — yields identical snapshots (the autoscaler's
+    decisions replay from the seed only if its inputs do)."""
+    from deeplearning_cfn_tpu.obs.signals import SignalBus
+
+    def _fold():
+        bus = SignalBus(names=["replica-0"])
+        bus.observe("replica-0", {"ts": 1.0, "serve_queue_depth": 4})
+        bus.observe("auto-both-0", {"ts": 1.2, "serve_queue_depth": 1})
+        bus.observe("auto-both-1", {"ts": 1.4, "serve_queue_depth": 1})
+        bus.observe("replica-0", {"ts": 2.0, "serve_queue_depth": 2})
+        return bus.snapshot()
+
+    assert _fold() == _fold()
+
+
+def test_fleet_tail_state_autoscale_membership_and_state():
+    """`obs tail --fleet` folds scale events into live membership and a
+    controller state; a fleet that never scales keeps the legacy status
+    line byte for byte."""
+    from deeplearning_cfn_tpu.obs.tail import FleetTailState
+
+    fixed = FleetTailState(["replica-0"])
+    fixed.update("replica-0", {"ts": 1.0, "serve_queue_depth": 0,
+                               "serve_submitted": 2,
+                               "serve_completed": 2})
+    legacy = fixed.status_line()
+    assert "members" not in legacy and "scale" not in legacy
+
+    st = FleetTailState(["replica-0", "#autoscale"])
+    st.update("replica-0", {"ts": 1.0, "serve_queue_depth": 4,
+                            "phase": "both"})
+    st.update("#autoscale", {"event": "scale_event", "action": "scale_up",
+                             "ts": 1.1, "phase": "both",
+                             "replica": "auto-both-0",
+                             "reason": "queue_depth 4 > 1.5"})
+    assert st.scale_state() == "scaling-up"
+    assert st.members == {"replica-0": "both", "auto-both-0": "both"}
+    line = st.status_line()
+    assert "members auto-both-0:both,replica-0:both" in line
+    assert "scale scaling-up" in line and "queue_depth 4 > 1.5" in line
+    # The control stream never pollutes the replica bus.
+    assert "#autoscale" not in st.bus.replicas
+    st.update("#autoscale", {"event": "scale_event",
+                             "action": "drain_begin", "ts": 2.0,
+                             "phase": "both", "replica": "auto-both-0",
+                             "reason": "pool calm"})
+    assert st.scale_state() == "draining"
+    st.update("#autoscale", {"event": "scale_event",
+                             "action": "scale_down", "ts": 2.1,
+                             "phase": "both", "replica": "auto-both-0",
+                             "reason": "drained idle", "drained": True})
+    assert st.scale_state() == "steady"
+    assert st.members == {"replica-0": "both"}
+    assert st.scale_ups == 1 and st.scale_downs == 1
+
+
+def test_fleet_tail_follows_autoscale_jsonl_and_new_replicas(tmp_path):
+    """End to end over a fleet root on disk: the tail discovers the
+    autoscale.jsonl control stream AND a replica dir created after the
+    follow started (autoscaled membership is not fixed at startup)."""
+    import io
+
+    from deeplearning_cfn_tpu.obs.tail import (
+        FleetTailState,
+        _fleet_followers,
+    )
+
+    root = tmp_path / "fleet"
+    (root / "replica-0").mkdir(parents=True)
+    (root / "replica-0" / "metrics.jsonl").write_text(json.dumps(
+        {"ts": 1.0, "serve_queue_depth": 1, "serve_submitted": 1,
+         "serve_completed": 0}) + "\n")
+    pairs = _fleet_followers(str(root))
+    names = [n for n, _ in pairs]
+    assert "#autoscale" in names
+    # A replica dir that appears later is picked up by a re-discovery.
+    (root / "auto-both-0").mkdir()
+    (root / "auto-both-0" / "metrics.jsonl").write_text(json.dumps(
+        {"ts": 2.0, "serve_queue_depth": 0, "serve_submitted": 1,
+         "serve_completed": 1}) + "\n")
+    (root / "autoscale.jsonl").write_text(json.dumps(
+        {"event": "scale_event", "action": "scale_up", "ts": 1.5,
+         "phase": "both", "replica": "auto-both-0",
+         "reason": "queue_depth 3 > 1.5"}) + "\n")
+    known = {f.path for _, f in pairs}
+    for name, f in _fleet_followers(str(root)):
+        if f.path not in known:
+            pairs.append((name, f))
+    assert {n for n, _ in pairs if not n.startswith("#")} \
+        == {"auto-both-0", "replica-0"}
+    st = FleetTailState([n for n, _ in pairs])
+    for name, f in pairs:
+        for rec in f.poll():
+            st.update(name, rec)
+    line = st.status_line()
+    assert "scale scaling-up" in line
+    assert "auto-both-0" in line
+
+    from deeplearning_cfn_tpu.obs.tail import tail
+    buf = io.StringIO()
+    assert tail(str(root), once=True, fleet=True, out=buf) == 0
+    assert "scale scaling-up" in buf.getvalue()
+
+
+def test_fold_autoscale_report_section():
+    """summarize --fleet's autoscale fold: counts, drained-vs-forced
+    split, and the steady/scaling-up/draining state derivation."""
+    from deeplearning_cfn_tpu.obs.report import fold_autoscale
+
+    up = {"event": "scale_event", "action": "scale_up", "ts": 1.0,
+          "phase": "both", "replica": "auto-both-0", "reason": "q"}
+    drain = {"event": "scale_event", "action": "drain_begin", "ts": 2.0,
+             "phase": "both", "replica": "auto-both-0", "reason": "calm"}
+    down = {"event": "scale_event", "action": "scale_down", "ts": 3.0,
+            "phase": "both", "replica": "auto-both-0",
+            "reason": "drained idle", "drained": True}
+    assert fold_autoscale([up])["state"] == "scaling-up"
+    assert fold_autoscale([up, drain])["state"] == "draining"
+    full = fold_autoscale([up, drain, down])
+    assert full["state"] == "steady"
+    assert full["scale_ups"] == 1 and full["scale_downs"] == 1
+    assert full["drained_scale_downs"] == 1
+    assert full["last_action"] == "scale_down"
+    assert full["last_reason"] == "drained idle"
