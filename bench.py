@@ -215,7 +215,11 @@ def _finalize_green(record: dict, alive: bool, probe_note: str,
                     "handoff_bytes", "kv_cache_bytes",
                     "spec_chain_len_p50", "host_syncs_per_token",
                     "offered_load_rps", "scale_events",
-                    "time_to_scale_s", "p95_during_burst"):
+                    "time_to_scale_s", "p95_during_burst",
+                    "qos_p95_by_class", "preemptions",
+                    "preempted_tokens_replayed",
+                    "fair_share_violation_max",
+                    "qos_decode_p95_no_adversary"):
             if key in record:
                 record[key] = None
     return record

@@ -495,7 +495,8 @@ def _cmd_serve(args) -> int:
     stdin), feeds it through the serve/ engine's slot table with overload
     backpressure, and prints one result JSON line per request. Requests are
     ``{"text": ...}`` (needs ``--vocab``) or ``{"src_ids": [...]}``, with
-    optional ``id``, ``max_new_tokens``, ``beam_size``, ``deadline_s``."""
+    optional ``id``, ``max_new_tokens``, ``beam_size``, ``deadline_s``,
+    ``tenant``, ``qos_class``."""
     cfg = apply_overrides(get_preset(args.preset), args.overrides)
     if args.accelerator:
         cfg.stack.accelerator = args.accelerator
@@ -573,6 +574,11 @@ def _cmd_serve(args) -> int:
         )
         if rec.get("deadline_s") is not None:
             kwargs["deadline_s"] = float(rec["deadline_s"])
+        # Optional multi-tenant QoS tags (same line keys as fleet
+        # route); untagged lines keep the pre-QoS submit shape.
+        for key in ("tenant", "qos_class"):
+            if rec.get(key) is not None:
+                kwargs[key] = str(rec[key])
         while True:
             try:
                 submitted.append(engine.submit(src_ids, **kwargs).id)
@@ -712,6 +718,12 @@ def _fleet_route_trace(router, trace, args):
                                        args.max_new_tokens)),
             request_id=rec.get("id"),
         )
+        # Per-request QoS tags ride in the trace line itself
+        # ({"tenant": ..., "qos_class": ...}); untagged lines keep the
+        # exact pre-QoS submit shape.
+        for key in ("tenant", "qos_class"):
+            if rec.get(key) is not None:
+                kwargs[key] = str(rec[key])
         while True:
             try:
                 rids.append(router.submit(item["src_ids"], **kwargs))
@@ -1855,11 +1867,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "decode replica count (pair with "
                          "--fleet-prefill)")
     be.add_argument("--trace-mix", default="uniform",
-                    choices=["uniform", "prefill-heavy"],
+                    choices=["uniform", "prefill-heavy", "tenants"],
                     help="fleet scenario: arrival mix — 'prefill-heavy' "
                          "interleaves long-prompt/short-decode "
                          "adversaries with short-prompt latency streams "
-                         "(the decode-interference trace)")
+                         "(the decode-interference trace); 'tenants' is "
+                         "the multi-tenant QoS mix (tenant-b batch-class "
+                         "bulk jobs flooding tenant-a latency-class "
+                         "streams — arms DRR admission + preemption and "
+                         "the qos_* record fields)")
     be.add_argument("--fleet-policy", default="least_loaded",
                     choices=["least_loaded", "round_robin"],
                     help="fleet scenario: routing policy")

@@ -74,6 +74,36 @@ def _single_engine_tokens(model, variables, pairs, slots: int,
     return [list(engine.poll(i).tokens) for i in ids]
 
 
+def _tenants_trace(num_requests: int, src_len: int, vocab: int,
+                   max_new_tokens: int, seed: int, corpus=None):
+    """The noisy-neighbour mix for the fixed-trace path: tenant-b's
+    bulk batch-class jobs (long prompt, full budget, submitted first so
+    they hold the slots) flood the fleet around tenant-a's
+    latency-class interactive streams. Returns ``(pairs, tags)`` —
+    ``tags[i]`` is the tenant/qos submit kwargs for ``pairs[i]``.
+    ``corpus`` (one token list per entry, e.g. wmt_sliver lines)
+    replaces the random prompts."""
+    rng = np.random.default_rng(seed)
+    short_len = max(2, src_len // 3)
+    pairs, tags = [], []
+    for i in range(num_requests):
+        if i % 3 == 2:
+            n, budget = short_len, max(1, max_new_tokens // 2)
+            tag = {"tenant": "tenant-a", "qos_class": "latency"}
+        else:
+            n, budget = src_len, max_new_tokens
+            tag = {"tenant": "tenant-b", "qos_class": "batch"}
+        if corpus is not None:
+            src = [int(t) for t in corpus[i % len(corpus)]][:n]
+            if not src:
+                raise ValueError(f"trace entry {i % len(corpus)} is empty")
+        else:
+            src = [int(t) for t in rng.integers(3, vocab, size=n)]
+        pairs.append((src, budget))
+        tags.append(tag)
+    return pairs, tags
+
+
 def _prefill_heavy_trace(num_requests: int, src_len: int, vocab: int,
                          max_new_tokens: int, seed: int):
     """The adversarial mix: even arrivals are long-prompt/short-decode
@@ -177,7 +207,7 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         raise ValueError(
             "disaggregation needs BOTH prefill and decode replicas (got "
             f"prefill={prefill_replicas}, decode={decode_replicas})")
-    if trace_mix not in ("uniform", "prefill-heavy"):
+    if trace_mix not in ("uniform", "prefill-heavy", "tenants"):
         raise ValueError(f"unknown trace mix {trace_mix!r}")
     disagg = prefill_replicas > 0
     if autoscale and trace_spec is None:
@@ -204,6 +234,7 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         np.zeros((1, src_len), np.int32), train=False)
     variables = {"params": init["params"]}
     spec = gen = vclock = None
+    qos_tags: Optional[List[Dict[str, str]]] = None
     if trace_spec is not None:
         # Open-loop replay: the seeded schedule is the trace. A `trace`
         # prompt list becomes the generator's prompt corpus; the bench
@@ -219,6 +250,14 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         pairs = gen.pairs()
         num_requests = len(pairs)
         vclock = VirtualClock()
+    elif trace_mix == "tenants":
+        # The tenant mix keeps its tags even when a prompt corpus
+        # (`trace`) supplies the tokens — the QOS_SMOKE gate replays
+        # wmt_sliver lines as two tenants' prompts.
+        pairs, qos_tags = _tenants_trace(
+            num_requests if trace is None else len(trace),
+            src_len, 96, max_new_tokens, seed, corpus=trace)
+        num_requests = len(pairs)
     elif trace is not None:
         pairs = [([int(t) for t in src], max_new_tokens) for src in trace]
         num_requests = len(pairs)
@@ -289,17 +328,50 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
             built.append(rep)
         return built, warm
 
-    def _drive(rt, drive_pairs, rid_prefix=None):
+    def _drive(rt, drive_pairs, rid_prefix=None, tags=None):
         out = []
         for i, (src, budget) in enumerate(drive_pairs):
             rid = None if rid_prefix is None else f"{rid_prefix}{i}"
+            kw = dict(tags[i]) if tags is not None else {}
             while True:
                 try:
                     out.append(rt.submit(src, max_new_tokens=budget,
-                                         request_id=rid))
+                                         request_id=rid, **kw))
                     break
                 except OverloadError:
                     rt.step()   # fleet backpressure: drain, then retry
+        return out, rt.run_until_drained()
+
+    def _drive_staggered(rt, drive_pairs, tags):
+        """Noisy-neighbour drive for the tenants mix: tenant-b's batch
+        flood is submitted first and stepped until it holds the decode
+        slots, THEN tenant-a's latency streams arrive mid-flight — the
+        arrival shape that exercises preemptive eviction (a latency
+        head that cannot place evicts a running batch stream). A
+        single up-front submit loop would let fair-share admission
+        seat the latency heads first and nothing would ever need
+        evicting. Returned rids stay in ``drive_pairs`` order so the
+        parity baselines line up index-for-index."""
+        out = [None] * len(drive_pairs)
+
+        def _submit(i):
+            src, budget = drive_pairs[i]
+            while True:
+                try:
+                    out[i] = rt.submit(src, max_new_tokens=budget,
+                                       **dict(tags[i]))
+                    return
+                except OverloadError:
+                    rt.step()
+
+        order = sorted(range(len(drive_pairs)),
+                       key=lambda i: tags[i]["qos_class"] == "latency")
+        n_flood = sum(1 for t in tags if t["qos_class"] != "latency")
+        for pos, i in enumerate(order):
+            if pos == n_flood:  # flood is in; let it start decoding
+                for _ in range(2):
+                    rt.step()
+            _submit(i)
         return out, rt.run_until_drained()
 
     def _decode_p95(rt, rt_rids, rt_pairs):
@@ -420,8 +492,10 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
                 router.step()
                 _on_tick(vclock.read())
                 vclock.advance(tick_s)
+    elif trace_mix == "tenants" and qos_tags is not None:
+        rids, ticks = _drive_staggered(router, pairs, qos_tags)
     else:
-        rids, ticks = _drive(router, pairs)
+        rids, ticks = _drive(router, pairs, tags=qos_tags)
     elapsed = time.monotonic() - t0
 
     results = [router.result(rid) for rid in rids]
@@ -455,8 +529,38 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
            if rid in router.ledger
            and router.ledger[rid]["e2e_s"] is not None]
     goodput = router.goodput_tokens
-    wasted = router.wasted_tokens
+    # Preemption waste is engine-internal (the router never abandons the
+    # stream), so it lives in the engines' ledgers, not the router's.
+    wasted = router.wasted_tokens + sum(
+        rep.engine.metrics.preempted_wasted_tokens for rep in members_all)
     goodput_sum_ok = (goodput + wasted) == total_tokens
+
+    # Multi-tenant QoS aggregates — None unless some request was
+    # tenant/class-tagged, so untagged records keep the pre-QoS shape.
+    qos_p95_by_class = None
+    preempt_total = replayed_total = token_loss_total = None
+    fair_share_max = None
+    if any(rep.engine.queue.qos_active for rep in members_all):
+        by_cls: Dict[str, List[float]] = {}
+        for rid in rids:
+            entry = router.ledger.get(rid)
+            if entry is None or "qos_class" not in entry:
+                continue
+            d = entry["phases"].get("decode_s")
+            if d is not None:
+                by_cls.setdefault(entry["qos_class"], []).append(d)
+        qos_p95_by_class = {c: percentile(v, 95)
+                            for c, v in sorted(by_cls.items())}
+        preempt_total = replayed_total = token_loss_total = 0
+        for rep in members_all:
+            m = rep.engine.metrics
+            preempt_total += m.preemptions
+            replayed_total += m.preempted_tokens_replayed
+            token_loss_total += m.qos_token_loss
+            v = rep.engine.queue.fair_share_violation_max()
+            if v is not None:
+                fair_share_max = (v if fair_share_max is None
+                                  else max(fair_share_max, v))
 
     if trace_dir is not None:
         from ..obs.signals import SignalBus
@@ -561,6 +665,11 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         "prefill_replicas": prefill_replicas,
         "decode_replicas": decode_replicas,
         "trace_mix": trace_mix,
+        "qos_p95_by_class": qos_p95_by_class,
+        "preemptions": preempt_total,
+        "preempted_tokens_replayed": replayed_total,
+        "qos_token_loss": token_loss_total,
+        "fair_share_violation_max": fair_share_max,
         "spec_gamma": speculate,
         "speculate_device": speculate_device,
         "kv_quant": kv_quant,
@@ -632,5 +741,50 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         record["handoff_bytes"] = (
             round(router.handoff_bytes_total / router.handoffs)
             if router.handoffs else None)
+
+    if trace_mix == "tenants" and not disagg:
+        # The QoS contract baseline: the SAME latency-class traffic
+        # with tenant-b's batch flood removed, through a fresh router
+        # over the same warmed members. "tenant-a's decode p95 flat vs
+        # this number" is the pinned contract — DRR admission plus
+        # preemptive eviction must hold the latency class at its
+        # uncontended bound while batch absorbs the slack.
+        if gen is not None:
+            import dataclasses
+
+            # Fresh request ids: the warmed engines' queues still hold
+            # the main run's finished entries under the lg-* ids.
+            lat_sched = tuple(
+                dataclasses.replace(s, request_id=f"noadv-{s.index:04d}")
+                for s in gen.schedule if s.qos_class == "latency")
+
+            class _LatencyOnly:
+                schedule = lat_sched
+                spec = gen.spec
+
+            vclock3 = VirtualClock()
+            _clock_ref[0] = vclock3
+            base_router = Router(members, policy=policy,
+                                 clock=_fleet_clock)
+            base_report = replay(_LatencyOnly, base_router, vclock3,
+                                 tick_s=tick_s)
+            base_rids = base_report.rids
+            _clock_ref[0] = vclock
+        else:
+            streams = [p for p, t in zip(pairs, qos_tags)
+                       if t["qos_class"] == "latency"]
+            stream_tags = [t for t in qos_tags
+                           if t["qos_class"] == "latency"]
+            base_router = Router(members, policy=policy)
+            base_rids, _ = _drive(base_router, streams,
+                                  rid_prefix="noadv-", tags=stream_tags)
+        vals = []
+        for rid in base_rids:
+            base_router.result(rid)
+            entry = base_router.ledger.get(rid)
+            d = None if entry is None else entry["phases"].get("decode_s")
+            if d is not None:
+                vals.append(d)
+        record["qos_decode_p95_no_adversary"] = percentile(vals, 95)
 
     return record

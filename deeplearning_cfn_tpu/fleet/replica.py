@@ -172,11 +172,12 @@ class EngineReplica:
             raise ReplicaCrashed(f"replica {self.id} is down")
         return self.engine.export_handoff(request_id)
 
-    def import_handoff(self, artifact, request_id: str, trace_id=None):
+    def import_handoff(self, artifact, request_id: str, trace_id=None,
+                       **qos_kwargs):
         if self.crashed:
             raise ReplicaCrashed(f"replica {self.id} is down")
         return self.engine.import_handoff(artifact, request_id,
-                                          trace_id=trace_id)
+                                          trace_id=trace_id, **qos_kwargs)
 
     def release_handoff(self, request_id: str) -> None:
         """Free the parked prefill state after a successful import. Runs

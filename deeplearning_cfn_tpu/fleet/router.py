@@ -312,18 +312,23 @@ class Router:
 
     def submit(self, src_ids, max_new_tokens: Optional[int] = None,
                beam_size: int = 1, deadline_s: Optional[float] = None,
-               request_id: Optional[str] = None) -> str:
+               request_id: Optional[str] = None,
+               tenant: Optional[str] = None,
+               qos_class: Optional[str] = None) -> str:
         """Place one logical request; returns its id. Raises
         :class:`FleetOverloadError` when every routable replica rejects
         it (the request is NOT retained — the caller owns the retry),
-        :class:`NoReplicasError` when nothing is routable at all."""
+        :class:`NoReplicasError` when nothing is routable at all.
+        ``tenant``/``qos_class`` ride in the replayed spec, so failover
+        and the prefill→decode hop preserve the request's QoS identity."""
         rid = request_id if request_id is not None \
             else f"fleet-{next(self._auto_id)}"
         if rid in self._requests:
             raise ValueError(f"duplicate request id {rid!r}")
         lr = _LogicalRequest(rid, dict(
             src_ids=list(src_ids), max_new_tokens=max_new_tokens,
-            beam_size=beam_size, deadline_s=deadline_s))
+            beam_size=beam_size, deadline_s=deadline_s,
+            tenant=tenant, qos_class=qos_class))
         lr.submitted_ts = self._clock()
         self._requests[rid] = lr
         try:
@@ -353,13 +358,18 @@ class Router:
             r = self._replicas[rep_id]
             lr.attempts += 1
             replica_rid = f"{lr.rid}#a{lr.attempts}"
+            # QoS identity is forwarded only when tagged, so pre-QoS
+            # replica fakes (and single-tenant traffic) see the exact
+            # historical call shape.
+            qos_kwargs = {k: lr.spec[k] for k in ("tenant", "qos_class")
+                          if lr.spec.get(k) is not None}
             try:
                 r.submit(lr.spec["src_ids"],
                          max_new_tokens=lr.spec["max_new_tokens"],
                          beam_size=lr.spec["beam_size"],
                          deadline_s=lr.spec["deadline_s"],
                          request_id=replica_rid,
-                         trace_id=lr.rid)
+                         trace_id=lr.rid, **qos_kwargs)
             except OverloadError as e:
                 hints[rep_id] = e.retry_after_s
                 continue
@@ -476,9 +486,11 @@ class Router:
             d = self._replicas[rep_id]
             lr.attempts += 1
             new_rid = f"{lr.rid}#a{lr.attempts}"
+            qos_kwargs = {k: lr.spec[k] for k in ("tenant", "qos_class")
+                          if lr.spec.get(k) is not None}
             try:
                 d.import_handoff(loaded, request_id=new_rid,
-                                 trace_id=lr.rid)
+                                 trace_id=lr.rid, **qos_kwargs)
             except OverloadError:
                 continue
             except ReplicaCrashed:
@@ -669,6 +681,11 @@ class Router:
             # Only hopped requests carry the extra phase — co-located
             # ledger entries keep the exact five-phase shape.
             phases["handoff_s"] = lr.handoff_s
+        preempted_s = getattr(req, "preempted_s", 0.0) or 0.0
+        if preempted_s > 0:
+            # Same conditionality as handoff_s: only streams that were
+            # actually evicted carry the parked-time phase.
+            phases["preempted_s"] = preempted_s
         self.ledger[lr.rid] = {
             "request_id": lr.rid, "state": state,
             "attempts": lr.attempts, "replicas": list(lr.hops),
@@ -676,6 +693,13 @@ class Router:
             "e2e_s": e2e,
             "phases": phases,
         }
+        if lr.spec.get("tenant") is not None \
+                or lr.spec.get("qos_class") is not None:
+            self.ledger[lr.rid]["tenant"] = lr.spec.get("tenant")
+            self.ledger[lr.rid]["qos_class"] = \
+                lr.spec.get("qos_class") or "standard"
+            self.ledger[lr.rid]["preemptions"] = \
+                getattr(req, "preemptions", 0)
         self._emit_request_span(lr, self.ledger[lr.rid])
 
     def _emit_request_span(self, lr: _LogicalRequest, entry: Dict) -> None:

@@ -61,6 +61,8 @@ class ScheduledRequest:
     src_ids: Tuple[int, ...]
     max_new_tokens: int
     prefix_group: Optional[str] = None
+    tenant: Optional[str] = None
+    qos_class: Optional[str] = None
 
 
 class LoadGenerator:
@@ -139,7 +141,8 @@ class LoadGenerator:
             schedule.append(ScheduledRequest(
                 index=i, request_id=f"lg-{i:04d}", at_s=at_s,
                 cls=cls.name, src_ids=tuple(src),
-                max_new_tokens=cls.max_new_tokens, prefix_group=group))
+                max_new_tokens=cls.max_new_tokens, prefix_group=group,
+                tenant=cls.tenant, qos_class=cls.qos_class))
         self.schedule: Tuple[ScheduledRequest, ...] = tuple(schedule)
 
     def pairs(self) -> List[Tuple[List[int], int]]:
@@ -196,6 +199,7 @@ def replay(gen: LoadGenerator, router, clock: VirtualClock,
             "class": s.cls, "scheduled_s": s.at_s, "submitted_s": None,
             "rejections": 0, "retry_after_honored": False,
             "outcome": "never_admitted", "prefix_group": s.prefix_group,
+            "tenant": s.tenant, "qos_class": s.qos_class,
         } for s in gen.schedule}
     rejections = 0
     ticks = 0
@@ -208,10 +212,15 @@ def replay(gen: LoadGenerator, router, clock: VirtualClock,
             due.append(heapq.heappop(retries)[2])
         for s in due:
             o = outcomes[s.request_id]
+            qos_kwargs: Dict[str, Any] = {}
+            if s.tenant is not None:
+                qos_kwargs["tenant"] = s.tenant
+            if s.qos_class is not None:
+                qos_kwargs["qos_class"] = s.qos_class
             try:
                 router.submit(list(s.src_ids),
                               max_new_tokens=s.max_new_tokens,
-                              request_id=s.request_id)
+                              request_id=s.request_id, **qos_kwargs)
             except OverloadError as e:
                 rejections += 1
                 o["rejections"] += 1

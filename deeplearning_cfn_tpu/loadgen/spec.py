@@ -31,7 +31,7 @@ from typing import Dict, List, Optional, Tuple
 from .arrivals import bursty_arrivals, diurnal_arrivals, poisson_arrivals
 
 PROCESSES = ("poisson", "burst", "diurnal")
-MIXES = ("uniform", "prefill-heavy")
+MIXES = ("uniform", "prefill-heavy", "tenants")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -40,7 +40,9 @@ class RequestClass:
     this class the schedule may contain (None = unbounded within
     ``max_requests``); ``prefix_groups > 0`` assigns the class's
     requests round-robin into that many groups, each sharing its first
-    ``prefix_len`` prompt tokens."""
+    ``prefix_len`` prompt tokens. ``tenant`` / ``qos_class`` tag every
+    request of the class for the fleet's multi-tenant QoS admission —
+    None means untagged (the pre-QoS single-tenant default)."""
 
     name: str
     src_len: int
@@ -49,6 +51,8 @@ class RequestClass:
     budget: Optional[int] = None
     prefix_groups: int = 0
     prefix_len: int = 0
+    tenant: Optional[str] = None
+    qos_class: Optional[str] = None
 
     def __post_init__(self):
         if self.src_len < 1:
@@ -136,6 +140,23 @@ def _classes_for_mix(mix: str, src_len: int,
                          max_new_tokens=min(2, max_new_tokens)),
             RequestClass("stream", src_len=short_len,
                          max_new_tokens=max_new_tokens),
+        )
+    if mix == "tenants":
+        # The noisy-neighbour mix: tenant-a's interactive streams
+        # (latency class, short prompts, tight budgets) share the fleet
+        # with tenant-b's bulk decode jobs (batch class, long budgets).
+        # Bulk outweighs interactive 2:1 in arrivals — the QoS admission
+        # and preemption layer is what keeps tenant-a's p95 flat.
+        short_len = max(2, src_len // 3)
+        return (
+            RequestClass("interactive", src_len=short_len,
+                         max_new_tokens=max(1, max_new_tokens // 2),
+                         weight=1.0, tenant="tenant-a",
+                         qos_class="latency"),
+            RequestClass("bulk", src_len=src_len,
+                         max_new_tokens=max_new_tokens,
+                         weight=2.0, tenant="tenant-b",
+                         qos_class="batch"),
         )
     return (RequestClass("base", src_len=src_len,
                          max_new_tokens=max_new_tokens),)

@@ -188,6 +188,18 @@ def summarize(path: str,
                 "p95": last.get("serve_step_latency_p95_s"),
             },
         }
+        # Per-tenant QoS section — only when the snapshot carries the
+        # QoS surface (single-tenant runs keep the exact pre-QoS keys).
+        if last.get("serve_qos_by_class") is not None:
+            out["serve"]["qos"] = {
+                "by_class": last.get("serve_qos_by_class"),
+                "preemptions": last.get("serve_preemptions"),
+                "preempted_tokens_replayed":
+                    last.get("serve_preempted_tokens_replayed"),
+                "token_loss": last.get("serve_qos_token_loss"),
+                "fair_share_violation_max":
+                    last.get("serve_fair_share_violation_max"),
+            }
 
     if spans:
         by_name: Dict[str, List[float]] = {}
@@ -284,6 +296,17 @@ def render_report(summary: Dict[str, Any]) -> str:
             p = s[key]
             L.append(f"  {label:<19} p50 {_fmt(p['p50'], 's')}  "
                      f"p95 {_fmt(p['p95'], 's')}")
+        q = s.get("qos")
+        if q:
+            L.append(f"  preemptions         {_fmt(q['preemptions'])}  "
+                     f"(replayed {_fmt(q['preempted_tokens_replayed'])}, "
+                     f"lost {_fmt(q['token_loss'])})")
+            L.append(f"  fair-share viol.    "
+                     f"{_fmt(q['fair_share_violation_max'])}")
+            for cls, v in sorted((q.get("by_class") or {}).items()):
+                L.append(f"  qos {cls:<15} n={_fmt(v.get('completed')):<5} "
+                         f"p50 {_fmt(v.get('latency_p50_s'), 's')}  "
+                         f"p95 {_fmt(v.get('latency_p95_s'), 's')}")
 
     sp = summary.get("spans")
     if sp:

@@ -49,6 +49,17 @@ Three kinds:
   inside budget — so `obs check` says WHICH stage of the request to go
   look at, not just that the p95 is bad.
 
+Any rule may carry an optional ``"class"`` — the per-tenant QoS form:
+
+    {"name": "latency-tenant-p95", "metric": "latency_p95_s",
+     "class": "latency", "kind": "threshold", "max": 0.5}
+
+instead of a top-level record key, the value is looked up through the
+serve snapshot's nested ``serve_qos_by_class[<class>][<metric>]``
+(``completed`` / ``latency_p50_s`` / ``latency_p95_s``), so each QoS
+class gets its own SLO — the batch tenant's p95 budget can be 20x the
+latency tenant's without either masking the other.
+
 Alerts are **edge-triggered**: a rule that stays in breach emits one
 alert at the ok→breach transition (and re-arms after recovering), so a
 degraded run produces a handful of alert lines, not one per record.
@@ -83,6 +94,12 @@ class Rule:
             raise RuleError(
                 f"rule {self.metric!r}: unknown kind {self.kind!r} "
                 f"(expected one of {', '.join(KINDS)})")
+        self.qos_class = spec.get("class")
+        if self.qos_class is not None and (
+                not isinstance(self.qos_class, str) or not self.qos_class):
+            raise RuleError(
+                f"rule {self.metric!r}: 'class' must be a non-empty "
+                f"string, got {self.qos_class!r}")
         self.name = str(spec.get("name") or f"{self.metric}-{self.kind}")
         self.max = spec.get("max")
         self.min = spec.get("min")
@@ -203,7 +220,16 @@ class Rule:
                 if isinstance(pv, (int, float)) \
                         and not isinstance(pv, bool):
                     self._phase_last[pname] = float(pv)
-        v = record.get(self.metric)
+        if self.qos_class is not None:
+            # Per-tenant form: the value lives in the serve snapshot's
+            # nested per-class section, not at the record's top level.
+            by_cls = record.get("serve_qos_by_class")
+            cls_rec = by_cls.get(self.qos_class) \
+                if isinstance(by_cls, dict) else None
+            v = cls_rec.get(self.metric) \
+                if isinstance(cls_rec, dict) else None
+        else:
+            v = record.get(self.metric)
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             return None
         breach = self._evaluate(float(v))
@@ -216,6 +242,8 @@ class Rule:
         self.fired += 1
         alert = {"event": "alert", "rule": self.name,
                  "metric": self.metric, "kind": self.kind, **breach}
+        if self.qos_class is not None:
+            alert["class"] = self.qos_class
         if isinstance(record.get("step"), (int, float)):
             alert["step"] = record["step"]
         return alert

@@ -97,6 +97,7 @@ class TailState:
         self.latency_p95_s: Optional[float] = None
         self.completed: Optional[Any] = None
         self.submitted: Optional[Any] = None
+        self.preemptions: Optional[Any] = None
         self.alerts = 0
         self.last_alert: Optional[str] = None
         self.launch_outcome: Optional[str] = None
@@ -121,7 +122,8 @@ class TailState:
                               ("tokens_per_sec", "serve_tokens_per_sec"),
                               ("latency_p95_s", "serve_latency_p95_s"),
                               ("completed", "serve_completed"),
-                              ("submitted", "serve_submitted")):
+                              ("submitted", "serve_submitted"),
+                              ("preemptions", "serve_preemptions")):
                 if key in r:
                     setattr(self, attr, r[key])
             return
@@ -148,9 +150,14 @@ class TailState:
                          f"{_f(self.examples_per_sec)} ex/s) "
                          f"loss {_f(self.loss)}")
         if self.submitted is not None or self.queue_depth is not None:
-            parts.append(f"serve q={_f(self.queue_depth)} "
-                         f"{_f(self.tokens_per_sec)} tok/s "
-                         f"done {_f(self.completed)}/{_f(self.submitted)}")
+            serve = (f"serve q={_f(self.queue_depth)} "
+                     f"{_f(self.tokens_per_sec)} tok/s "
+                     f"done {_f(self.completed)}/{_f(self.submitted)}")
+            if self.preemptions is not None:
+                # Only QoS-active engines emit serve_preemptions —
+                # single-tenant status lines stay byte-identical.
+                serve += f" preempt {_f(self.preemptions)}"
+            parts.append(serve)
         if self.launch_outcome is not None:
             parts.append(f"launch {self.launch_outcome}")
         alerts = f"alerts {self.alerts}"
@@ -189,6 +196,9 @@ class FleetTailState:
         self.last_scale: Optional[Dict[str, Any]] = None
         self._open_drains: set = set()
         self._scale_seen = False
+        # Per-replica preemption counters (QoS fleets only — the key is
+        # absent from single-tenant snapshots).
+        self._preemptions: Dict[str, int] = {}
 
     def update(self, name: str, rec: Dict[str, Any]) -> None:
         if rec.get("event") == "scale_event":
@@ -215,6 +225,8 @@ class FleetTailState:
             self.members[name] = rec.get("phase")
         elif self.members[name] is None and rec.get("phase"):
             self.members[name] = rec.get("phase")
+        if isinstance(rec.get("serve_preemptions"), (int, float)):
+            self._preemptions[name] = int(rec["serve_preemptions"])
         self.bus.observe(name, rec)
 
     def scale_state(self) -> str:
@@ -242,6 +254,8 @@ class FleetTailState:
                  f"done {_f(f['completed'])}/{_f(f['submitted'])}",
                  f"worst p95 {_f(f['worst_latency_p95_s'])}",
                  f"alerts {f['alerts']}"]
+        if self._preemptions:
+            parts.insert(3, f"preempt {sum(self._preemptions.values())}")
         fails = {n: s.launch_outcome
                  for n, s in self.bus.replicas.items()
                  if s.launch_outcome not in (None, "ok")}

@@ -12,7 +12,9 @@ KV-cache decoder machinery (models/decoding.py, models/transformer_nmt.py):
   ledger) behind the paged engine;
 - :mod:`.prefix` — LRU encoder-output cache keyed on padded source tokens;
 - :mod:`.queue` — bounded request lifecycle (submit/poll/cancel, deadlines,
-  explicit overload rejection);
+  explicit overload rejection) plus multi-tenant QoS admission: per-class
+  deficit-round-robin fair share, per-tenant rate limits, and the
+  preemption hooks the engine's latency-class eviction path uses;
 - :mod:`.loader` — checkpoint restore + tokenizer binding;
 - :mod:`.quant` — weight-only int8 checkpoint quantization for the
   ``--quantize int8`` serving mode;
@@ -33,8 +35,12 @@ from .quant import (  # noqa: F401
     variables_bytes,
 )
 from .queue import (  # noqa: F401
+    DEFAULT_QOS_CLASS,
     OverloadError,
+    QosSpec,
+    RateLimitError,
     Request,
     RequestQueue,
     RequestState,
+    default_qos_classes,
 )

@@ -94,7 +94,8 @@ from ..obs.trace import span
 from .blockpool import BlockAllocator, is_pool_leaf
 from .metrics import ServeMetrics
 from .prefix import PrefixCache
-from .queue import OverloadError, Request, RequestQueue, RequestState
+from .queue import (OverloadError, QosSpec, Request, RequestQueue,
+                    RequestState)
 
 
 @dataclass
@@ -158,7 +159,8 @@ class Engine:
                  clock=time.monotonic,
                  metrics: Optional[ServeMetrics] = None,
                  retry_after_floor_s: Optional[float]
-                 = RequestQueue.DEFAULT_RETRY_AFTER_FLOOR_S):
+                 = RequestQueue.DEFAULT_RETRY_AFTER_FLOOR_S,
+                 qos_classes: Optional[Dict[str, QosSpec]] = None):
         if capacity <= 0:
             raise ValueError(f"capacity must be positive, got {capacity}")
         if decode_window <= 0:
@@ -236,12 +238,19 @@ class Engine:
         self.length_penalty = length_penalty
         self._clock = clock
         self.queue = RequestQueue(max_depth=queue_depth, clock=clock,
-                                  retry_after_floor_s=retry_after_floor_s)
+                                  retry_after_floor_s=retry_after_floor_s,
+                                  qos_classes=qos_classes)
         self.metrics = metrics if metrics is not None \
             else ServeMetrics(capacity, clock=clock)
         # The phase ledger + goodput accounting is always on for engine
         # requests (bare ServeMetrics instances keep the base surface).
         self.metrics.configure_request_ledger()
+        # The QoS surface (preemptions, per-class latency) appears only
+        # once multi-tenancy is actually in play — at construction for an
+        # explicit policy, lazily at the first tenant-tagged submit
+        # otherwise — so single-tenant runs keep byte-identical records.
+        if self.queue.qos_active:
+            self.metrics.configure_qos()
 
         # Speculative decoding (Leviathan et al.): a draft model proposes
         # speculate_gamma tokens per row autoregressively, the target
@@ -470,8 +479,11 @@ class Engine:
                max_new_tokens: Optional[int] = None, beam_size: int = 1,
                deadline_s: Optional[float] = None,
                request_id: Optional[str] = None,
-               trace_id: Optional[str] = None) -> Request:
-        """Validate + enqueue. Raises OverloadError when the queue is full,
+               trace_id: Optional[str] = None,
+               tenant: Optional[str] = None,
+               qos_class: Optional[str] = None) -> Request:
+        """Validate + enqueue. Raises OverloadError when the queue is full
+        (RateLimitError when a per-tenant class rate limit rejects),
         ValueError on requests the engine could never place."""
         if not src_ids:
             raise ValueError("src_ids must be non-empty")
@@ -496,10 +508,15 @@ class Engine:
             req = self.queue.submit(src_ids, budget, beam_size=beam_size,
                                     deadline_s=deadline_s,
                                     request_id=request_id,
-                                    trace_id=trace_id)
+                                    trace_id=trace_id,
+                                    tenant=tenant, qos_class=qos_class)
         except OverloadError as e:
+            if self.queue.qos_active:
+                self.metrics.configure_qos()
             self.metrics.record_reject(e.retry_after_s)
             raise
+        if self.queue.qos_active:
+            self.metrics.configure_qos()
         self.metrics.record_submit()
         return req
 
@@ -675,6 +692,22 @@ class Engine:
             self.metrics.record_ledger(
                 goodput=kept, wasted=max(0, group.decoded - kept),
                 reason="beam_discard")
+            self.metrics.record_qos_finish(group.req.qos_class,
+                                           group.req.latency_s)
+            if group.req.parked_tokens:
+                # Zero-token-loss audit: the resumed stream must have
+                # reproduced every token it had emitted before eviction
+                # (restart-from-scratch + deterministic search make the
+                # parked sequence a prefix of the final one).
+                parked = group.req.parked_tokens
+                toks = group.req.tokens
+                matched = 0
+                for a, b in zip(parked, toks):
+                    if a != b:
+                        break
+                    matched += 1
+                self.metrics.record_preempt_resume_audit(
+                    replayed=matched, lost=len(parked) - matched)
         else:
             self.metrics.record_ledger(wasted=group.decoded,
                                        reason="preempted")
@@ -737,56 +770,127 @@ class Engine:
                 g.req.state = RequestState.PREFILLED
                 self._handoff_ready[g.req.id] = g
 
+    def _preempt(self, group: _Group, now: float) -> None:
+        """Evict a RUNNING preemptible group so a higher-priority request
+        can place: ledger its decode work as preempted waste (the resumed
+        attempt re-decodes — and re-ledgers — those positions), free its
+        rows and refcounted blocks, park the longest emitted token prefix
+        for the zero-loss audit, and reinstate it at the front of its
+        class sub-queue. NOT a release: the request is not finished, so
+        no record_finish/trace — its lifecycle continues on resume."""
+        self.metrics.record_ledger(wasted=group.decoded, reason="preempted")
+        self._free_group_resources(group)
+        self._groups.remove(group)
+        req = group.req
+        if len(req.tokens) > len(req.parked_tokens):
+            req.parked_tokens = list(req.tokens)
+        req.tokens = []
+        req.prefill_s = None
+        req.preemptions += 1
+        req.preempted_at = now
+        self.metrics.record_preemption()
+        self.queue.reinstate(req)
+
+    def _pick_victim(self, now: float) -> Optional[_Group]:
+        """The group a blocked higher-priority head may evict: among
+        RUNNING groups whose class is preemptible AND strictly outranked
+        by the head's class, prefer the lowest-ranked class, then the
+        least sunk decode work, then the most recent admission (LIFO —
+        the oldest best-effort stream is closest to done)."""
+        head = self.queue.peek_priority_head(now)
+        if head is None:
+            return None
+        head_prio = self.queue.qos_spec(head.qos_class).priority
+        candidates = []
+        for g in self._groups:
+            spec = self.queue.qos_spec(g.req.qos_class)
+            if spec.preemptible and spec.priority > head_prio:
+                candidates.append((spec.priority, -g.decoded,
+                                   g.req.admitted_at or 0.0, g))
+        if not candidates:
+            return None
+        candidates.sort(key=lambda c: (-c[0], c[1], -c[2]))
+        return candidates[0][3]
+
     def _admit(self, now: float) -> None:
         """Admit every queued request that fits, then prefill them all in
         ONE padded encode + one donated scatter into the row tables —
         instead of N sequential [1, S] encodes and N full-table
-        ``.at[r].set`` copies."""
+        ``.at[r].set`` copies. When multi-tenant QoS is active and a
+        higher-priority head still cannot place, preemptively evict
+        best-effort groups (one at a time, re-running admission after
+        each) until it places or no eligible victim remains."""
         free = self._free_rows()
         admits: List[_Group] = []
         can_place = None
         if self.paged:
             # Token-budget admission: the head is admissible only while
             # the pool can cover its worst-case block reservation. The
-            # predicate reads `free` through the closure, so it tracks
-            # rows handed out earlier in this same admit loop.
+            # predicate reads `free` through the closure cell, so it
+            # tracks rows handed out earlier in this same admit loop and
+            # rows refreshed after a preemption.
             def can_place(req):
                 return (req.beam_size <= len(free)
                         and self.allocator.can_commit(self._peak_blocks(
                             req.beam_size, req.max_new_tokens)))
-        while free:
-            req = self.queue.pop_ready(now, can_place=can_place)
-            if req is None:
+        while True:
+            while free:
+                req = self.queue.pop_ready(now, can_place=can_place)
+                if req is None:
+                    break
+                w = req.beam_size
+                if w > len(free):
+                    # FIFO: don't let a smaller later request jump the
+                    # line.
+                    self.queue.requeue_front(req)
+                    break
+                rows, free = free[:w], free[w:]
+                resumed = req.preempted_at is not None
+                for r in rows:
+                    assert self._row_owner[r] is None, \
+                        f"admit into occupied row {r}"
+                    self._prev[r] = BOS_ID
+                    self._pos[r] = 0
+                    self._row_owner[r] = req.id
+                group = _Group(req=req, rows=rows,
+                               budget=req.max_new_tokens)
+                if self.paged:
+                    peak = self._peak_blocks(w, group.budget)
+                    self.allocator.commit(peak)
+                    group.committed_blocks = peak
+                if w > 1:
+                    group.scores = np.full((w,), -1e9, np.float32)
+                    group.scores[0] = 0.0
+                    group.beam_done = np.zeros((w,), bool)
+                    group.beam_tokens = np.full((w, group.budget + 1),
+                                                PAD_ID, np.int32)
+                    group.beam_tokens[:, 0] = BOS_ID
+                admits.append(group)
+                self._groups.append(group)
+                req.state = RequestState.RUNNING
+                req.admitted_at = now
+                if resumed:
+                    # Re-admission of a preempted stream: restart decode
+                    # from scratch (determinism regenerates the parked
+                    # prefix token-identically; the prefix cache absorbs
+                    # the re-encode). Parked wall time accrues to the
+                    # ledger's `preempted` phase, and the second "wait"
+                    # stays out of the admission-latency samples.
+                    req.preempted_s += now - req.preempted_at
+                    req.preempted_at = None
+                    req.tokens = []
+                else:
+                    self.metrics.record_admit(now - req.submitted_at)
+            if not self.queue.qos_active:
                 break
-            w = req.beam_size
-            if w > len(free):
-                # FIFO: don't let a smaller later request jump the line.
-                self.queue.requeue_front(req)
+            victim = self._pick_victim(now)
+            if victim is None or victim in admits:
                 break
-            rows, free = free[:w], free[w:]
-            for r in rows:
-                assert self._row_owner[r] is None, \
-                    f"admit into occupied row {r}"
-                self._prev[r] = BOS_ID
-                self._pos[r] = 0
-                self._row_owner[r] = req.id
-            group = _Group(req=req, rows=rows, budget=req.max_new_tokens)
-            if self.paged:
-                peak = self._peak_blocks(w, group.budget)
-                self.allocator.commit(peak)
-                group.committed_blocks = peak
-            if w > 1:
-                group.scores = np.full((w,), -1e9, np.float32)
-                group.scores[0] = 0.0
-                group.beam_done = np.zeros((w,), bool)
-                group.beam_tokens = np.full((w, group.budget + 1), PAD_ID,
-                                            np.int32)
-                group.beam_tokens[:, 0] = BOS_ID
-            admits.append(group)
-            self._groups.append(group)
-            req.state = RequestState.RUNNING
-            req.admitted_at = now
-            self.metrics.record_admit(now - req.submitted_at)
+            self._preempt(victim, now)
+            free = self._free_rows()
+        if admits:
+            self.metrics.set_qos_fair_share(
+                self.queue.fair_share_violation_max())
         if not admits:
             return
         t_prefill = self._clock()
@@ -992,6 +1096,17 @@ class Engine:
         if self.queue.depth > 0 and any(
                 o is None for o in self._row_owner):
             return 1
+        if self.queue.qos_active:
+            # A pending request that outranks a running preemptible
+            # group must not wait out a fused window before it can evict
+            # — drop to single-step ticks while that holds. Inert for
+            # single-tenant traffic (qos_active stays False).
+            pend = self.queue.min_pending_priority()
+            if pend is not None:
+                for g in self._groups:
+                    spec = self.queue.qos_spec(g.req.qos_class)
+                    if spec.preemptible and spec.priority > pend:
+                        return 1
         return self.decode_window
 
     # -- the speculative window --------------------------------------------
@@ -1612,7 +1727,9 @@ class Engine:
 
     def import_handoff(self, artifact: Dict[str, np.ndarray],
                        request_id: str,
-                       trace_id: Optional[str] = None) -> Request:
+                       trace_id: Optional[str] = None,
+                       tenant: Optional[str] = None,
+                       qos_class: Optional[str] = None) -> Request:
         """Ingest a handoff artifact into this engine's own block pool
         and resume decode mid-stream. Block ids are remapped through the
         importer's free list (the artifact carries pool-independent
@@ -1668,8 +1785,15 @@ class Engine:
             state=RequestState.RUNNING, submitted_at=now,
             admitted_at=now,
             tokens=[int(t) for t in artifact["tokens"]],
-            trace_id=trace_id)
+            trace_id=trace_id, tenant=tenant,
+            qos_class=qos_class or "standard")
         self.queue.adopt(req)
+        if tenant is not None or req.qos_class != "standard":
+            # An imported best-effort stream must be preemptible here
+            # too: flip the queue's QoS mode and the metric surface just
+            # as a tagged submit would.
+            self.queue.qos_active = True
+            self.metrics.configure_qos()
         self.metrics.record_submit()
         self.metrics.record_admit(0.0)
         self.allocator.commit(peak)

@@ -99,6 +99,12 @@ class ServeMetrics:
         self._spec_chain_emitted = None
         self._spec_chain_len = None
         self._kv_quant_bytes = None
+        self._qos_preemptions = None
+        self._qos_replayed = None
+        self._qos_token_loss = None
+        self._qos_completed = None
+        self._qos_latency: Dict[str, object] = {}
+        self._qos_fair_share = None
         self._goodput = None
         self._waste = None
         self._phase_prefill = None
@@ -173,6 +179,65 @@ class ServeMetrics:
             "serve_kv_quant_bytes",
             "quantized KV pool bytes as stored (codes + scales)")
         self._kv_quant_bytes.set(int(pool_bytes))
+
+    def configure_qos(self) -> None:
+        """Enable the multi-tenant QoS surface (serve_preemptions,
+        serve_qos_*). The engine turns this on lazily, the first time a
+        submit names a tenant or a non-default class — single-tenant
+        runs keep emitting byte-identical records (the exact-key
+        snapshot contract)."""
+        if self._qos_preemptions is not None:
+            return
+        r = self.registry
+        self._qos_preemptions = r.counter(
+            "serve_preemptions_total",
+            "running streams evicted for a higher-priority request")
+        self._qos_replayed = r.counter(
+            "serve_preempted_tokens_replayed_total",
+            "parked tokens regenerated token-identically after resume")
+        self._qos_token_loss = r.counter(
+            "serve_qos_token_loss_total",
+            "parked tokens a resumed stream failed to reproduce")
+        self._qos_completed = r.counter(
+            "serve_qos_completed_total", "completed requests by qos class")
+        self._qos_fair_share = r.gauge(
+            "serve_fair_share_violation_max",
+            "worst per-class shortfall vs weighted fair share")
+
+    def record_preemption(self) -> None:
+        if self._qos_preemptions is not None:
+            self._qos_preemptions.inc()
+
+    def record_preempt_resume_audit(self, replayed: int, lost: int) -> None:
+        """Zero-token-loss audit at a resumed stream's finish: ``replayed``
+        parked tokens were reproduced identically, ``lost`` were not
+        (always 0 under the determinism contract — nonzero fails the
+        QOS_SMOKE gate)."""
+        if self._qos_replayed is None:
+            return
+        if replayed:
+            self._qos_replayed.inc(replayed)
+        if lost:
+            self._qos_token_loss.inc(lost)
+
+    def record_qos_finish(self, qos_class: str,
+                          latency: Optional[float]) -> None:
+        """Per-class completion + latency sample (DONE requests only)."""
+        if self._qos_completed is None:
+            return
+        self._qos_completed.inc(qos_class=qos_class)
+        if latency is not None:
+            hist = self._qos_latency.get(qos_class)
+            if hist is None:
+                hist = self.registry.histogram(
+                    f"serve_qos_latency_s_{qos_class}",
+                    f"submit to finish, class {qos_class}")
+                self._qos_latency[qos_class] = hist
+            hist.observe(latency)
+
+    def set_qos_fair_share(self, violation: Optional[float]) -> None:
+        if self._qos_fair_share is not None and violation is not None:
+            self._qos_fair_share.set(violation)
 
     def record_spec_chain(self, windows: int, syncs: int,
                           emitted: int) -> None:
@@ -537,6 +602,43 @@ class ServeMetrics:
         return self._spec_chain_syncs.value() / emitted
 
     @property
+    def preemptions(self) -> int:
+        if self._qos_preemptions is None:
+            return 0
+        return int(self._qos_preemptions.value())
+
+    @property
+    def preempted_tokens_replayed(self) -> int:
+        if self._qos_replayed is None:
+            return 0
+        return int(self._qos_replayed.value())
+
+    @property
+    def qos_token_loss(self) -> int:
+        if self._qos_token_loss is None:
+            return 0
+        return int(self._qos_token_loss.value())
+
+    def qos_by_class(self) -> Dict[str, Dict]:
+        """Per-class completion counts and latency percentiles."""
+        if self._qos_completed is None:
+            return {}
+        out: Dict[str, Dict] = {}
+        for key, count in self._qos_completed.series().items():
+            cls = dict(key).get("qos_class")
+            if cls is None:
+                continue
+            hist = self._qos_latency.get(cls)
+            out[cls] = {
+                "completed": int(count),
+                "latency_p50_s":
+                    hist.percentile(50) if hist is not None else None,
+                "latency_p95_s":
+                    hist.percentile(95) if hist is not None else None,
+            }
+        return out
+
+    @property
     def goodput_tokens(self) -> int:
         if self._goodput is None:
             return 0
@@ -548,6 +650,17 @@ class ServeMetrics:
         if self._waste is None:
             return 0
         return int(sum(self._waste.series().values()))
+
+    @property
+    def preempted_wasted_tokens(self) -> int:
+        """Tokens ledgered as waste by preemptive eviction. Preemption
+        is engine-internal — the router never abandons the stream — so
+        fleet-level goodput accounting must read this from the engines,
+        not from the router's evacuation ledger."""
+        if self._waste is None:
+            return 0
+        return int(sum(v for k, v in self._waste.series().items()
+                       if dict(k).get("reason") == "preempted"))
 
     @property
     def wasted_draft_tokens(self) -> int:
@@ -631,6 +744,14 @@ class ServeMetrics:
         if self._kv_quant_bytes is not None:
             snap["serve_kv_quant_bytes"] = \
                 int(self._kv_quant_bytes.value())
+        if self._qos_preemptions is not None:
+            snap["serve_preemptions"] = self.preemptions
+            snap["serve_preempted_tokens_replayed"] = \
+                self.preempted_tokens_replayed
+            snap["serve_qos_token_loss"] = self.qos_token_loss
+            snap["serve_fair_share_violation_max"] = \
+                self._qos_fair_share.value()
+            snap["serve_qos_by_class"] = self.qos_by_class()
         if self._goodput is not None:
             snap["serve_goodput_tokens"] = self.goodput_tokens
             snap["serve_wasted_tokens"] = self.wasted_tokens

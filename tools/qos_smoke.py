@@ -1,0 +1,75 @@
+"""QOS smoke gate — run by tools/t1.sh.
+
+Routes a two-tenant trace (tenant-a latency-class interactive traffic
+interleaved with tenant-b batch-class bulk work, sources drawn from the
+wmt_sliver fixture) through the fleet bench and asserts the multi-tenant
+contract end to end:
+
+- zero dropped requests (fair-share admission sheds with retry-after
+  hints instead of silently losing work),
+- at least one audited preemption: a latency-class arrival evicted a
+  running batch stream, whose replayed continuation is token-identical
+  (``qos_token_loss == 0``),
+- token parity vs the single-engine baseline (QoS scheduling must be
+  invisible in outputs),
+- the goodput ledger still balances (``goodput + wasted == decoded``),
+- latency-class decode p95 stays within a generous bound of the
+  no-adversary baseline the same invocation measures (the batch flood
+  must not starve the latency tenant),
+- full determinism: a second run produces identical per-class p95s and
+  the identical preemption count.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning_cfn_tpu.fleet.bench import run_fleet_bench
+
+
+def main() -> int:
+    sliver = os.path.join("tests", "data", "wmt_sliver.de")
+    with open(sliver, "rb") as fh:
+        lines = [ln for ln in fh.read().splitlines() if ln.strip()]
+    # Byte-derived token ids in the bench vocab (>= 3 skips the
+    # pad/bos/eos reserved ids), capped to the smoke src_len.
+    trace = [[3 + (b % 93) for b in ln[:8]] for ln in lines][:6]
+    assert len(trace) >= 3, "wmt_sliver fixture too small for the gate"
+
+    # decode_window=1 keeps the batch flood mid-decode for several fleet
+    # steps, so the staggered latency arrivals land while every slot is
+    # held by an evictable stream.
+    runs = [run_fleet_bench(smoke=True, trace_mix="tenants", trace=trace,
+                            decode_window=1)
+            for _ in range(2)]
+    r = runs[0]
+    assert r["dropped_requests"] == 0, r
+    assert r["token_identical"] is True, r
+    assert r["goodput_sum_ok"] is True, r
+    assert r["preemptions"] >= 1, r
+    assert r["qos_token_loss"] == 0, r
+    by_cls = r["qos_p95_by_class"]
+    assert by_cls and "latency" in by_cls and "batch" in by_cls, r
+    lat_p95 = by_cls["latency"]
+    noadv = r["qos_decode_p95_no_adversary"]
+    assert lat_p95 is not None and noadv is not None, r
+    # The latency tenant must not be starved by the batch flood. The
+    # bound is deliberately loose (CPU smoke timings are noisy at this
+    # scale) — it exists to catch order-of-magnitude starvation, which
+    # is what a broken fair-share scheduler produces.
+    assert lat_p95 <= 5.0 * noadv + 0.5, (lat_p95, noadv)
+    # Determinism: the same trace yields the same per-class latencies
+    # under the virtual clock and the same preemption decisions.
+    assert runs[0]["preemptions"] == runs[1]["preemptions"]
+    assert runs[0]["qos_token_loss"] == runs[1]["qos_token_loss"]
+    print(f"QOS_SMOKE=OK preemptions={r['preemptions']} "
+          f"replayed={r['preempted_tokens_replayed']} "
+          f"token_loss={r['qos_token_loss']} "
+          f"latency_p95={lat_p95:.4f} no_adversary_p95={noadv:.4f} "
+          f"fair_share_violation_max={r['fair_share_violation_max']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
