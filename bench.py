@@ -219,7 +219,12 @@ def _finalize_green(record: dict, alive: bool, probe_note: str,
                     "qos_p95_by_class", "preemptions",
                     "preempted_tokens_replayed",
                     "fair_share_violation_max",
-                    "qos_decode_p95_no_adversary"):
+                    "qos_decode_p95_no_adversary",
+                    "radix_hit_tokens_per_request",
+                    "prefill_tokens_saved_ratio",
+                    "radix_hit_rate", "radix_sweep",
+                    "radix_hit_rate_prefix_affinity",
+                    "radix_hit_rate_round_robin"):
             if key in record:
                 record[key] = None
     return record

@@ -346,6 +346,19 @@ def _cmd_bench(args) -> int:
         print("[dlcfn-tpu] --trace/--autoscale are fleet-scenario flags — "
               "pass them with --fleet", file=sys.stderr)
         return 2
+    if getattr(args, "radix_cache", False) \
+            and not getattr(args, "fleet", False):
+        print("[dlcfn-tpu] --radix-cache is a fleet-scenario flag — pass "
+              "it with --fleet", file=sys.stderr)
+        return 2
+    if getattr(args, "radix_cache", False) \
+            and (getattr(args, "fleet_prefill", 0)
+                 or getattr(args, "fleet_decode", 0)):
+        print("[dlcfn-tpu] --radix-cache needs co-located replicas — a "
+              "phase-split stream never owns a reusable finished block "
+              "table (drop --fleet-prefill/--fleet-decode)",
+              file=sys.stderr)
+        return 2
     if getattr(args, "fleet", False):
         if getattr(args, "ops", None) or args.collectives or \
                 getattr(args, "sweep_batches", None) or \
@@ -374,6 +387,7 @@ def _cmd_bench(args) -> int:
                                speculate=args.speculate,
                                speculate_device=args.speculate_device,
                                kv_quant=args.kv_quant,
+                               radix=args.radix_cache,
                                trace_spec=args.trace,
                                autoscale=args.autoscale,
                                min_replicas=args.min_replicas,
@@ -522,6 +536,7 @@ def _cmd_serve(args) -> int:
             speculate_device=args.speculate_device,
             draft_cfg=args.draft or None,
             quantize=args.quantize, kv_quant=args.kv_quant,
+            radix_cache=args.radix_cache,
             step=args.step, vocab=args.vocab, allow_init=args.allow_init)
     except (FileNotFoundError, ValueError) as e:
         print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
@@ -684,6 +699,12 @@ def _fleet_build_replicas(args, n: int, specs=None, kv_block_size: int = 0):
         force_cpu_platform()
     replicas, at_step = [], None
     bpe = None
+    radix = getattr(args, "radix_cache", False)
+    if radix and kv_block_size == 0:
+        # The radix cache lives on the paged KV path — co-located
+        # route/rollout fleets default to dense rows, so arming it pulls
+        # in the serve default block size.
+        kv_block_size = 16
     roles = specs if specs is not None \
         else [(f"replica-{i}", "both") for i in range(n)]
     for name, phase in roles:
@@ -699,6 +720,7 @@ def _fleet_build_replicas(args, n: int, specs=None, kv_block_size: int = 0):
             speculate_device=getattr(args, "speculate_device", False),
             quantize=getattr(args, "quantize", ""),
             kv_quant=getattr(args, "kv_quant", ""),
+            radix_cache=radix and phase == "both",
             phase=phase,
             vocab=args.vocab, allow_init=args.allow_init)
         replicas.append(EngineReplica(name, engine))
@@ -763,6 +785,11 @@ def _fleet_up_disagg(args) -> int:
     if args.prefill < 1 or args.decode < 1:
         print("[dlcfn-tpu] a disaggregated fleet needs BOTH --prefill "
               ">= 1 and --decode >= 1", file=sys.stderr)
+        return 2
+    if getattr(args, "radix_cache", False):
+        print("[dlcfn-tpu] --radix-cache needs co-located replicas — a "
+              "phase-split stream never owns a reusable finished block "
+              "table", file=sys.stderr)
         return 2
     cfg = apply_overrides(get_preset(args.preset), args.overrides)
     if args.accelerator:
@@ -876,6 +903,8 @@ def _cmd_fleet_up(args) -> int:
             argv += ["--quantize", args.quantize]
         if getattr(args, "kv_quant", ""):
             argv += ["--kv-quant", args.kv_quant]
+        if getattr(args, "radix_cache", False):
+            argv += ["--radix-cache"]
         if args.accelerator:
             argv += ["--accelerator", args.accelerator]
         if args.vocab:
@@ -1595,7 +1624,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "plus the null sentinel)")
     sv.add_argument("--prefix-cache", type=int, default=32,
                     help="encoder prefix-cache entries, keyed on the "
-                         "padded source tokens (0 = disabled)")
+                         "unpadded source tokens — trailing PAD "
+                         "stripped (0 = disabled)")
+    sv.add_argument("--radix-cache", action="store_true",
+                    help="radix token-prefix KV cache: finished greedy "
+                         "streams' paged block tables are retained in a "
+                         "refcounted radix tree and shared with later "
+                         "identical-source requests (resume or instant-"
+                         "complete); needs --kv-block-size > 0")
     sv.add_argument("--speculate", type=int, default=0,
                     help="speculative decoding: draft tokens proposed per "
                          "verify step (0 = off); self-draft without a "
@@ -1670,6 +1706,12 @@ def build_parser() -> argparse.ArgumentParser:
                        help="per-replica int8 paged KV cache (needs the "
                             "paged path; disagg topologies are paged "
                             "already)")
+        p.add_argument("--radix-cache", action="store_true",
+                       help="per-replica radix token-prefix KV cache "
+                            "(forces the paged path; co-located "
+                            "replicas only — pair with the "
+                            "prefix_affinity policy to keep repeats on "
+                            "one replica's cache)")
         p.add_argument("--vocab", default="",
                        help="BPE vocab.json — required for \"text\" "
                             "requests")
@@ -1697,7 +1739,8 @@ def build_parser() -> argparse.ArgumentParser:
                       help="disaggregated topology: paged KV block size "
                            "(the handoff artifact is block-structured)")
     flup.add_argument("--policy", default="least_loaded",
-                      choices=["least_loaded", "round_robin"],
+                      choices=["least_loaded", "round_robin",
+                               "prefix_affinity"],
                       help="disaggregated topology: routing policy")
     flup.add_argument("--run-root", default="",
                       help="fleet run root; per-replica run dirs are "
@@ -1722,8 +1765,12 @@ def build_parser() -> argparse.ArgumentParser:
              "behind the router; one result line per request")
     _add_fleet_engine_flags(flrt)
     flrt.add_argument("--policy", default="least_loaded",
-                      choices=["least_loaded", "round_robin"],
-                      help="routing policy")
+                      choices=["least_loaded", "round_robin",
+                               "prefix_affinity"],
+                      help="routing policy (prefix_affinity: rendezvous-"
+                           "hash each request's cache-affinity key — "
+                           "its leading source tokens — to a preferred "
+                           "replica, least-loaded fallback)")
     flrt.add_argument("overrides", nargs="*",
                       help="config overrides — at least the workdir the "
                            "training run used")
@@ -1736,7 +1783,8 @@ def build_parser() -> argparse.ArgumentParser:
              "requests")
     _add_fleet_engine_flags(flro)
     flro.add_argument("--policy", default="least_loaded",
-                      choices=["least_loaded", "round_robin"],
+                      choices=["least_loaded", "round_robin",
+                               "prefix_affinity"],
                       help="routing policy")
     flro.add_argument("--to-step", type=int, default=0,
                       help="committed checkpoint step to upgrade to "
@@ -1867,7 +1915,8 @@ def build_parser() -> argparse.ArgumentParser:
                          "decode replica count (pair with "
                          "--fleet-prefill)")
     be.add_argument("--trace-mix", default="uniform",
-                    choices=["uniform", "prefill-heavy", "tenants"],
+                    choices=["uniform", "prefill-heavy", "tenants",
+                             "prefix-heavy"],
                     help="fleet scenario: arrival mix — 'prefill-heavy' "
                          "interleaves long-prompt/short-decode "
                          "adversaries with short-prompt latency streams "
@@ -1875,10 +1924,24 @@ def build_parser() -> argparse.ArgumentParser:
                          "the multi-tenant QoS mix (tenant-b batch-class "
                          "bulk jobs flooding tenant-a latency-class "
                          "streams — arms DRR admission + preemption and "
-                         "the qos_* record fields)")
+                         "the qos_* record fields); 'prefix-heavy' "
+                         "repeats a handful of whole prompts round-robin "
+                         "(the shared-system-prompt trace the radix "
+                         "cache feeds on — with --radix-cache the "
+                         "record gains the sharing sweep and the "
+                         "prefix_affinity-vs-round_robin hit-rate "
+                         "comparison)")
     be.add_argument("--fleet-policy", default="least_loaded",
-                    choices=["least_loaded", "round_robin"],
+                    choices=["least_loaded", "round_robin",
+                             "prefix_affinity"],
                     help="fleet scenario: routing policy")
+    be.add_argument("--radix-cache", action="store_true",
+                    help="fleet scenario: per-replica radix token-prefix "
+                         "KV cache (forces the paged path fleet-wide; "
+                         "the parity baseline stays cold-cache, and the "
+                         "record gains radix_hit_rate / "
+                         "radix_hit_tokens_per_request / "
+                         "prefill_tokens_saved_ratio)")
     be.add_argument("--fleet-chaos-step", type=int, default=0,
                     help="fleet scenario: crash-inject replica-0 on its "
                          "Nth decode step (0 = off) — the chaos variant "

@@ -52,7 +52,10 @@ def _single_engine_tokens(model, variables, pairs, slots: int,
     returns the per-trace-index token lists the fleet output must
     match. ``kv_block_size > 0`` runs the paged path (the disagg
     topologies are paged, so their baseline is too). The speculation and
-    KV-quant knobs mirror the fleet's so parity stays apples-to-apples."""
+    KV-quant knobs mirror the fleet's so parity stays apples-to-apples.
+    The radix knob deliberately does NOT: the baseline is always
+    cold-cache, so a radix fleet's ``token_identical`` proves cached
+    reuse changes no tokens."""
     engine = Engine(model, variables, capacity=slots, max_src_len=src_len,
                     queue_depth=len(pairs) + 1,
                     default_max_new_tokens=max_new_tokens,
@@ -104,6 +107,43 @@ def _tenants_trace(num_requests: int, src_len: int, vocab: int,
     return pairs, tags
 
 
+#: The fixed prompt pool size for the prefix-heavy trace. Pools are
+#: NESTED: the group-g trace draws its prompts from the first g entries
+#: of one seeded pool, so sweeping g only removes distinct sources —
+#: cold decode work is monotone in g by construction, which is what the
+#: radix sweep's monotonicity contract leans on.
+_PREFIX_POOL = 8
+
+
+def _prefix_group_trace(num_requests: int, src_len: int, vocab: int,
+                        max_new_tokens: int, seed: int, groups: int,
+                        corpus=None):
+    """The shared-system-prompt mix the radix cache feeds on: requests
+    repeat ``groups`` WHOLE prompts round-robin (identical full sources
+    — the condition decoder-KV sharing needs in an encoder-decoder
+    model). Returns ``(pairs, tags)``; ``tags[i]`` carries the group id
+    as the router ``affinity_key`` so cache-aware policies can steer
+    group members to one replica. ``corpus`` (one token list per entry,
+    e.g. wmt_sliver lines) replaces the random prompt pool."""
+    if groups < 1:
+        raise ValueError(f"groups must be >= 1, got {groups}")
+    rng = np.random.default_rng(seed)
+    pool = [[int(t) for t in rng.integers(3, vocab, size=src_len)]
+            for _ in range(max(_PREFIX_POOL, groups))]
+    if corpus is not None:
+        for j in range(len(pool)):
+            src = [int(t) for t in corpus[j % len(corpus)]][:src_len]
+            if not src:
+                raise ValueError(f"corpus entry {j % len(corpus)} is empty")
+            pool[j] = src
+    pairs, tags = [], []
+    for i in range(num_requests):
+        g = i % groups
+        pairs.append((list(pool[g]), max_new_tokens))
+        tags.append({"affinity_key": f"grp-{g}"})
+    return pairs, tags
+
+
 def _prefill_heavy_trace(num_requests: int, src_len: int, vocab: int,
                          max_new_tokens: int, seed: int):
     """The adversarial mix: even arrivals are long-prompt/short-decode
@@ -139,6 +179,7 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
                     speculate: int = 0,
                     speculate_device: bool = False,
                     kv_quant: str = "",
+                    radix: bool = False,
                     trace_spec: Optional[str] = None,
                     autoscale: bool = False,
                     min_replicas: int = 1,
@@ -172,6 +213,17 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
     engine's speculative-decoding and int8 KV-cache knobs through every
     replica AND the single-engine parity baseline (``kv_quant`` forces
     the paged path fleet-wide, since int8 blocks only exist there).
+
+    ``radix`` arms each replica's radix token-prefix KV cache (forcing
+    the paged path fleet-wide). The parity baseline stays COLD-cache so
+    ``token_identical`` proves cached reuse changes no tokens. With
+    ``trace_mix='prefix-heavy'`` (requests repeating a handful of whole
+    prompts, each tagged with its group id as the router affinity key)
+    the record additionally carries the cache-efficiency evidence: a
+    sharing sweep (``radix_sweep`` — decoded tokens per request must
+    fall monotonically as the prompt-group count shrinks) and the
+    policy comparison (``radix_hit_rate_prefix_affinity`` vs
+    ``radix_hit_rate_round_robin`` over the same trace and fleet).
 
     ``trace_dir`` arms fleet tracing: each replica writes its span shard
     to ``<dir>/<replica>/metrics.jsonl``, the router writes its
@@ -207,9 +259,14 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         raise ValueError(
             "disaggregation needs BOTH prefill and decode replicas (got "
             f"prefill={prefill_replicas}, decode={decode_replicas})")
-    if trace_mix not in ("uniform", "prefill-heavy", "tenants"):
+    if trace_mix not in ("uniform", "prefill-heavy", "tenants",
+                         "prefix-heavy"):
         raise ValueError(f"unknown trace mix {trace_mix!r}")
     disagg = prefill_replicas > 0
+    if radix and disagg:
+        raise ValueError("the radix cache needs co-located replicas "
+                         "(phase='both'): a split prefill/decode stream "
+                         "never owns a reusable finished block table)")
     if autoscale and trace_spec is None:
         raise ValueError("autoscale needs a trace spec (--trace): the "
                          "controller runs on the open-loop replay clock")
@@ -258,6 +315,16 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
             num_requests if trace is None else len(trace),
             src_len, 96, max_new_tokens, seed, corpus=trace)
         num_requests = len(pairs)
+    elif trace_mix == "prefix-heavy":
+        # Two whole-prompt groups by default — every group repeats many
+        # times, the shape the radix cache (and the RADIX_SMOKE gate's
+        # wmt_sliver corpus replay) feeds on. The sweep below varies the
+        # group count itself.
+        pairs, qos_tags = _prefix_group_trace(
+            num_requests if trace is None else max(len(trace),
+                                                   num_requests),
+            src_len, 96, max_new_tokens, seed, groups=2, corpus=trace)
+        num_requests = len(pairs)
     elif trace is not None:
         pairs = [([int(t) for t in src], max_new_tokens) for src in trace]
         num_requests = len(pairs)
@@ -273,7 +340,7 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
     # block-structured); the co-located contract fleet and the parity
     # baseline use the same block size so the comparison is
     # apples-to-apples.
-    kv_block_size = 4 if (disagg or kv_quant) else 0
+    kv_block_size = 4 if (disagg or kv_quant or radix) else 0
 
     fault_plan = None
     if chaos_kill_step > 0:
@@ -308,6 +375,7 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
                             speculate_gamma=speculate,
                             speculate_device=speculate_device,
                             kv_quant=kv_quant,
+                            radix_cache=radix,
                             phase=phase,
                             clock=_fleet_clock)
             rep = EngineReplica(name, engine, fault_plan=plan)
@@ -399,6 +467,25 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
     else:
         specs = [(f"replica-{i}", "both") for i in range(replicas)]
     members, warmup_tokens = _build_fleet(specs, fault_plan)
+    if radix:
+        # The per-replica warmup stream populated each radix tree with
+        # pairs[0] — drop it so the timed run starts cold and every hit
+        # the record reports came from routed traffic actually sharing.
+        for rep in members:
+            rep.engine.reset_radix_cache()
+
+    # Per-replica radix counters at the start of the timed window: the
+    # warmup stream's lookup (a miss on the fresh cache) must not skew
+    # the record's hit rate, so everything below reads deltas.
+    warm_radix: Dict[str, tuple] = {}
+
+    def _radix_mark(rep):
+        m = rep.engine.metrics
+        warm_radix[rep.id] = (m.radix_hits, m.radix_misses,
+                              m.radix_hit_tokens)
+
+    for rep in members:
+        _radix_mark(rep)
     if vclock is not None:
         router = Router(members, policy=policy, clock=_fleet_clock)
     else:
@@ -443,6 +530,7 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
             built, w = _build_fleet([(rid, phase)], None)
             warmup_tokens.update(w)
             rep = built[0]
+            _radix_mark(rep)
             members_all.append(rep)
             if trace_dir is not None:
                 w2 = MetricsWriter(
@@ -533,7 +621,19 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
     # stream), so it lives in the engines' ledgers, not the router's.
     wasted = router.wasted_tokens + sum(
         rep.engine.metrics.preempted_wasted_tokens for rep in members_all)
-    goodput_sum_ok = (goodput + wasted) == total_tokens
+    # Radix-supplied tokens appear in results (so the router's goodput
+    # and evacuation-waste ledgers count them) without ever being
+    # decoded by an engine — the conservation identity gains them on
+    # the decoded side. Zero when the cache is off.
+    radix_hits_n = radix_lookups_n = radix_hit_tok = 0
+    if radix:
+        for rep in members_all:
+            m = rep.engine.metrics
+            h0, m0, t0_ = warm_radix.get(rep.id, (0, 0, 0))
+            radix_hits_n += m.radix_hits - h0
+            radix_lookups_n += (m.radix_hits - h0) + (m.radix_misses - m0)
+            radix_hit_tok += m.radix_hit_tokens - t0_
+    goodput_sum_ok = (goodput + wasted) == total_tokens + radix_hit_tok
 
     # Multi-tenant QoS aggregates — None unless some request was
     # tenant/class-tagged, so untagged records keep the pre-QoS shape.
@@ -673,6 +773,21 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         "spec_gamma": speculate,
         "speculate_device": speculate_device,
         "kv_quant": kv_quant,
+        # -- radix token-prefix KV cache (None when the cache is off) --
+        "radix": radix,
+        "radix_hit_rate":
+            round(radix_hits_n / radix_lookups_n, 4)
+            if radix and radix_lookups_n else None,
+        "radix_hit_tokens_per_request":
+            round(radix_hit_tok / num_requests, 3)
+            if radix and num_requests else None,
+        "prefill_tokens_saved_ratio":
+            round(radix_hit_tok / (radix_hit_tok + total_tokens), 4)
+            if radix and (radix_hit_tok + total_tokens) else None,
+        "radix_sweep": None,
+        "radix_prefill_monotonic": None,
+        "radix_hit_rate_prefix_affinity": None,
+        "radix_hit_rate_round_robin": None,
         # -- open-loop replay / closed-loop autoscale -----------------
         "trace_spec": trace_spec,
         "autoscale": autoscale,
@@ -699,6 +814,73 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         "min_replicas": min_replicas if autoscale else None,
         "max_replicas": max_replicas if autoscale else None,
     }
+
+    if radix and trace_mix == "prefix-heavy" and not disagg \
+            and trace_spec is None and chaos_kill_step == 0:
+        # The cache-efficiency evidence, over the SAME warmed members
+        # (fresh router + cold caches per run, so every number is a
+        # clean per-run delta):
+        #   1. the sharing sweep — fewer prompt groups means more
+        #      requests repeat a source, and the nested prompt pool
+        #      makes decoded-tokens-per-request monotone in the group
+        #      count by construction (cold work is a sum over the first
+        #      g pool entries);
+        #   2. prefix_affinity vs round_robin on one trace — rendezvous
+        #      steering keeps each group's repeats on one replica's
+        #      cache, round-robin splits them, so the hit rate must
+        #      separate.
+
+        def _measured_drive(drive_pairs, drive_tags, pol, rid_prefix):
+            for rep in members:
+                rep.engine.reset_radix_cache()
+            rt = Router(members, policy=pol)
+            before = {}
+            for rep in members:
+                m = rep.engine.metrics
+                before[rep.id] = (m.tokens_generated, m.radix_hits,
+                                  m.radix_misses)
+            rr, _ = _drive(rt, drive_pairs, rid_prefix=rid_prefix,
+                           tags=drive_tags)
+            for rid2 in rr:
+                rt.result(rid2)
+            dec = hits = lookups = 0
+            for rep in members:
+                m = rep.engine.metrics
+                t0_, h0, m0 = before[rep.id]
+                dec += m.tokens_generated - t0_
+                hits += m.radix_hits - h0
+                lookups += (m.radix_hits - h0) + (m.radix_misses - m0)
+            return dec, hits, lookups
+
+        sweep = []
+        for g in (4, 2, 1):
+            if g > num_requests:
+                continue
+            sp, st = _prefix_group_trace(num_requests, src_len, 96,
+                                         max_new_tokens, seed, groups=g,
+                                         corpus=trace)
+            dec, h, lk = _measured_drive(sp, st, "prefix_affinity",
+                                         f"sw{g}-")
+            sweep.append({
+                "prefix_groups": g,
+                "decoded_tokens_per_request": round(dec / num_requests, 3),
+                "hit_rate": round(h / lk, 4) if lk else None,
+            })
+        dpr = [row["decoded_tokens_per_request"] for row in sweep]
+        record["radix_sweep"] = sweep
+        record["radix_prefill_monotonic"] = all(
+            a >= b for a, b in zip(dpr, dpr[1:]))
+
+        sp, st = _prefix_group_trace(num_requests, src_len, 96,
+                                     max_new_tokens, seed, groups=2,
+                                     corpus=trace)
+        _, h_aff, lk_aff = _measured_drive(sp, st, "prefix_affinity",
+                                           "aff-")
+        _, h_rr, lk_rr = _measured_drive(sp, st, "round_robin", "rr-")
+        record["radix_hit_rate_prefix_affinity"] = (
+            round(h_aff / lk_aff, 4) if lk_aff else None)
+        record["radix_hit_rate_round_robin"] = (
+            round(h_rr / lk_rr, 4) if lk_rr else None)
 
     if disagg:
         # The contract run: the SAME trace through a co-located paged

@@ -31,6 +31,7 @@ suite runs them over fake replicas with scripted loads).
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import time
 from typing import Dict, List, Optional, Tuple
@@ -77,6 +78,12 @@ class RoutingPolicy:
 
     def note_routed(self, replica_id: str) -> None:
         """Called after a submit lands on ``replica_id``."""
+
+    def order_for(self, candidates: List[Tuple[str, Dict]],
+                  affinity_key: Optional[str] = None) -> List[str]:
+        """Request-aware ordering hook: like :meth:`order` but handed the
+        request's cache-affinity key. The base policies ignore it."""
+        return self.order(candidates)
 
 
 class RoundRobinPolicy(RoutingPolicy):
@@ -127,9 +134,53 @@ class LeastLoadedPolicy(RoutingPolicy):
         return [rid for rid, _ in sorted(candidates, key=load_key)]
 
 
+class PrefixAffinityPolicy(RoutingPolicy):
+    """Cache-aware placement: a request carrying an affinity key (the
+    loadgen prefix-group id in the bench; a first-N-source-token hash
+    otherwise) is steered to a preferred replica so per-replica radix
+    trees stay hot, falling back to least-loaded order for the rest of
+    the candidates (and entirely for keyless requests).
+
+    The preferred replica is chosen by rendezvous (highest-random-weight)
+    hashing of ``(key, replica_id)``: every key independently ranks the
+    live replica set, so removing a replica (drain, autoscale-down,
+    crash) remaps ONLY the keys that preferred it — no thundering
+    re-hash of every group's placement, unlike modulo hashing. blake2b
+    keeps the weights deterministic across processes and runs (the
+    policy-determinism contract; ``hash()`` is salted per process)."""
+
+    name = "prefix_affinity"
+    # Keyless requests derive their affinity from this many leading
+    # source tokens — "the longest expected prefix" a router can see
+    # without protocol help.
+    affinity_tokens = 8
+
+    def __init__(self):
+        self._fallback = LeastLoadedPolicy()
+
+    @staticmethod
+    def _weight(key: str, rid: str) -> int:
+        digest = hashlib.blake2b(
+            f"{key}\x00{rid}".encode(), digest_size=8).digest()
+        return int.from_bytes(digest, "big")
+
+    def order(self, candidates):
+        return self._fallback.order(candidates)
+
+    def order_for(self, candidates, affinity_key=None):
+        rest = self._fallback.order(candidates)
+        if affinity_key is None or not candidates:
+            return rest
+        key = str(affinity_key)
+        preferred = max((rid for rid, _ in candidates),
+                        key=lambda rid: (self._weight(key, rid), rid))
+        return [preferred] + [rid for rid in rest if rid != preferred]
+
+
 POLICIES = {
     RoundRobinPolicy.name: RoundRobinPolicy,
     LeastLoadedPolicy.name: LeastLoadedPolicy,
+    PrefixAffinityPolicy.name: PrefixAffinityPolicy,
 }
 
 
@@ -314,13 +365,17 @@ class Router:
                beam_size: int = 1, deadline_s: Optional[float] = None,
                request_id: Optional[str] = None,
                tenant: Optional[str] = None,
-               qos_class: Optional[str] = None) -> str:
+               qos_class: Optional[str] = None,
+               affinity_key: Optional[str] = None) -> str:
         """Place one logical request; returns its id. Raises
         :class:`FleetOverloadError` when every routable replica rejects
         it (the request is NOT retained — the caller owns the retry),
         :class:`NoReplicasError` when nothing is routable at all.
         ``tenant``/``qos_class`` ride in the replayed spec, so failover
-        and the prefill→decode hop preserve the request's QoS identity."""
+        and the prefill→decode hop preserve the request's QoS identity.
+        ``affinity_key`` names the request's expected shared prefix
+        (loadgen prefix-group id) for cache-aware policies; it stays
+        router-side — replicas never see it."""
         rid = request_id if request_id is not None \
             else f"fleet-{next(self._auto_id)}"
         if rid in self._requests:
@@ -328,7 +383,8 @@ class Router:
         lr = _LogicalRequest(rid, dict(
             src_ids=list(src_ids), max_new_tokens=max_new_tokens,
             beam_size=beam_size, deadline_s=deadline_s,
-            tenant=tenant, qos_class=qos_class))
+            tenant=tenant, qos_class=qos_class,
+            affinity_key=affinity_key))
         lr.submitted_ts = self._clock()
         self._requests[rid] = lr
         try:
@@ -337,6 +393,20 @@ class Router:
             del self._requests[rid]
             raise
         return rid
+
+    def _affinity_for(self, lr: _LogicalRequest) -> Optional[str]:
+        """The request's cache-affinity key: the caller-provided one
+        (loadgen prefix-group id) when present, else — for policies that
+        want one — a hash key over the leading source tokens, the
+        longest shared prefix the router can infer on its own."""
+        key = lr.spec.get("affinity_key")
+        if key is not None:
+            return str(key)
+        n = int(getattr(self.policy, "affinity_tokens", 0) or 0)
+        if n <= 0:
+            return None
+        return "tok:" + ",".join(
+            str(int(t)) for t in lr.spec["src_ids"][:n])
 
     def _place(self, lr: _LogicalRequest) -> None:
         candidates = self._routable()
@@ -349,8 +419,9 @@ class Router:
         if not candidates:
             raise NoReplicasError(
                 "no routable replicas (all down, broken, or draining)")
-        ordered = self.policy.order(
-            [(r.id, r.health()) for r in candidates])
+        ordered = self.policy.order_for(
+            [(r.id, r.health()) for r in candidates],
+            self._affinity_for(lr))
         hints: Dict[str, Optional[float]] = {}
         depth = sum(r.engine.queue.depth for r in candidates)
         max_depth = sum(r.engine.queue.max_depth for r in candidates)
@@ -480,8 +551,9 @@ class Router:
         loaded = load_handoff(store, key)
         candidates = [r for r in self._routable()
                       if getattr(r, "phase", "both") in ("decode", "both")]
-        ordered = self.policy.order(
-            [(r.id, r.health()) for r in candidates])
+        ordered = self.policy.order_for(
+            [(r.id, r.health()) for r in candidates],
+            self._affinity_for(lr))
         for rep_id in ordered:
             d = self._replicas[rep_id]
             lr.attempts += 1

@@ -217,6 +217,12 @@ def replay(gen: LoadGenerator, router, clock: VirtualClock,
                 qos_kwargs["tenant"] = s.tenant
             if s.qos_class is not None:
                 qos_kwargs["qos_class"] = s.qos_class
+            if s.prefix_group is not None:
+                # The loadgen knows the request's shared prefix by
+                # construction — hand the group id to the router as its
+                # cache-affinity key (cache-aware policies steer on it;
+                # the others ignore it).
+                qos_kwargs["affinity_key"] = s.prefix_group
             try:
                 router.submit(list(s.src_ids),
                               max_new_tokens=s.max_new_tokens,

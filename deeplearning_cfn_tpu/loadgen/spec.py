@@ -31,7 +31,7 @@ from typing import Dict, List, Optional, Tuple
 from .arrivals import bursty_arrivals, diurnal_arrivals, poisson_arrivals
 
 PROCESSES = ("poisson", "burst", "diurnal")
-MIXES = ("uniform", "prefill-heavy", "tenants")
+MIXES = ("uniform", "prefill-heavy", "tenants", "prefix-heavy")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -141,6 +141,25 @@ def _classes_for_mix(mix: str, src_len: int,
             RequestClass("stream", src_len=short_len,
                          max_new_tokens=max_new_tokens),
         )
+    if mix == "prefix-heavy":
+        # The shared-system-prompt mix the radix token-prefix cache
+        # feeds on: two tenants whose requests repeat a handful of
+        # WHOLE prompts (prefix_len == src_len — members of a prefix
+        # group share the entire source, the identical-source condition
+        # decoder-KV sharing needs in an encoder-decoder model). The
+        # group count is deliberately small so every group repeats many
+        # times; `prefix_groups=` on the trace spec overrides it to
+        # sweep the sharing level.
+        return (
+            RequestClass("sys-a", src_len=src_len,
+                         max_new_tokens=max_new_tokens, weight=2.0,
+                         tenant="tenant-a",
+                         prefix_groups=2, prefix_len=src_len),
+            RequestClass("sys-b", src_len=src_len,
+                         max_new_tokens=max_new_tokens, weight=1.0,
+                         tenant="tenant-b",
+                         prefix_groups=2, prefix_len=src_len),
+        )
     if mix == "tenants":
         # The noisy-neighbour mix: tenant-a's interactive streams
         # (latency class, short prompts, tight budgets) share the fleet
@@ -225,7 +244,11 @@ def parse_trace_spec(text: str, src_len: int = 12,
     classes = _classes_for_mix(mix, src_len, max_new_tokens)
     groups = int(_num("prefix_groups", 0))
     if groups:
-        plen = int(_num("prefix_len", max(1, src_len // 2)))
+        # prefix-heavy keeps whole-prompt sharing under a prefix_groups
+        # sweep: identical full sources are what decoder-KV (radix)
+        # sharing needs, not just a common head.
+        plen = int(_num("prefix_len", src_len if mix == "prefix-heavy"
+                        else max(1, src_len // 2)))
         classes = tuple(
             dataclasses.replace(c, prefix_groups=groups,
                                 prefix_len=min(plen, c.src_len))

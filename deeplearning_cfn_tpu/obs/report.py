@@ -200,6 +200,24 @@ def summarize(path: str,
                 "fair_share_violation_max":
                     last.get("serve_fair_share_violation_max"),
             }
+        # Radix token-prefix KV cache section — only when the snapshot
+        # carries the radix surface (--radix-cache runs).
+        if last.get("serve_radix_nodes") is not None:
+            out["serve"]["radix"] = {
+                "nodes": last.get("serve_radix_nodes"),
+                "blocks": last.get("serve_radix_blocks"),
+                "hits": last.get("serve_radix_hits"),
+                "misses": last.get("serve_radix_misses"),
+                "hit_rate": last.get("serve_radix_hit_rate"),
+                "instant_completes":
+                    last.get("serve_radix_instant_completes"),
+                "hit_tokens": last.get("serve_radix_hit_tokens"),
+                "shared_block_ratio":
+                    last.get("serve_radix_shared_block_ratio"),
+                "evictions": last.get("serve_radix_evictions"),
+                "evictions_by_cause":
+                    last.get("serve_radix_evictions_by_cause"),
+            }
 
     if spans:
         by_name: Dict[str, List[float]] = {}
@@ -307,6 +325,21 @@ def render_report(summary: Dict[str, Any]) -> str:
                 L.append(f"  qos {cls:<15} n={_fmt(v.get('completed')):<5} "
                          f"p50 {_fmt(v.get('latency_p50_s'), 's')}  "
                          f"p95 {_fmt(v.get('latency_p95_s'), 's')}")
+        rx = s.get("radix")
+        if rx:
+            L.append(f"  radix cache         {_fmt(rx['nodes'])} nodes / "
+                     f"{_fmt(rx['blocks'])} blocks  "
+                     f"hit rate {_fmt(rx['hit_rate'])}")
+            L.append(f"  radix reuse         {_fmt(rx['hits'])} hits "
+                     f"({_fmt(rx['instant_completes'])} instant), "
+                     f"{_fmt(rx['hit_tokens'])} tokens, "
+                     f"shared-block ratio "
+                     f"{_fmt(rx['shared_block_ratio'])}")
+            causes = rx.get("evictions_by_cause") or {}
+            cause_txt = ", ".join(f"{c}={n}"
+                                  for c, n in sorted(causes.items()))
+            L.append(f"  radix evictions     {_fmt(rx['evictions'])}"
+                     + (f"  ({cause_txt})" if cause_txt else ""))
 
     sp = summary.get("spans")
     if sp:

@@ -98,6 +98,8 @@ class TailState:
         self.completed: Optional[Any] = None
         self.submitted: Optional[Any] = None
         self.preemptions: Optional[Any] = None
+        self.radix_hits: Optional[Any] = None
+        self.radix_hit_rate: Optional[Any] = None
         self.alerts = 0
         self.last_alert: Optional[str] = None
         self.launch_outcome: Optional[str] = None
@@ -123,7 +125,9 @@ class TailState:
                               ("latency_p95_s", "serve_latency_p95_s"),
                               ("completed", "serve_completed"),
                               ("submitted", "serve_submitted"),
-                              ("preemptions", "serve_preemptions")):
+                              ("preemptions", "serve_preemptions"),
+                              ("radix_hits", "serve_radix_hits"),
+                              ("radix_hit_rate", "serve_radix_hit_rate")):
                 if key in r:
                     setattr(self, attr, r[key])
             return
@@ -157,6 +161,11 @@ class TailState:
                 # Only QoS-active engines emit serve_preemptions —
                 # single-tenant status lines stay byte-identical.
                 serve += f" preempt {_f(self.preemptions)}"
+            if self.radix_hits is not None:
+                # Only --radix-cache engines emit serve_radix_* — other
+                # configurations' status lines stay byte-identical.
+                serve += (f" radix {_f(self.radix_hits)}"
+                          f"@{_f(self.radix_hit_rate)}")
             parts.append(serve)
         if self.launch_outcome is not None:
             parts.append(f"launch {self.launch_outcome}")
@@ -199,6 +208,8 @@ class FleetTailState:
         # Per-replica preemption counters (QoS fleets only — the key is
         # absent from single-tenant snapshots).
         self._preemptions: Dict[str, int] = {}
+        # Per-replica radix hit counters (--radix-cache fleets only).
+        self._radix_hits: Dict[str, int] = {}
 
     def update(self, name: str, rec: Dict[str, Any]) -> None:
         if rec.get("event") == "scale_event":
@@ -227,6 +238,8 @@ class FleetTailState:
             self.members[name] = rec.get("phase")
         if isinstance(rec.get("serve_preemptions"), (int, float)):
             self._preemptions[name] = int(rec["serve_preemptions"])
+        if isinstance(rec.get("serve_radix_hits"), (int, float)):
+            self._radix_hits[name] = int(rec["serve_radix_hits"])
         self.bus.observe(name, rec)
 
     def scale_state(self) -> str:
@@ -256,6 +269,8 @@ class FleetTailState:
                  f"alerts {f['alerts']}"]
         if self._preemptions:
             parts.insert(3, f"preempt {sum(self._preemptions.values())}")
+        if self._radix_hits:
+            parts.insert(3, f"radix {sum(self._radix_hits.values())}")
         fails = {n: s.launch_outcome
                  for n, s in self.bus.replicas.items()
                  if s.launch_outcome not in (None, "ok")}

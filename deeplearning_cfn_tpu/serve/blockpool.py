@@ -166,3 +166,10 @@ class BlockAllocator:
 
     def refcount(self, block: int) -> int:
         return self._ref.get(block, 0)
+
+    def refcounts(self) -> Dict[int, int]:
+        """Snapshot of live block refcounts (block id -> count). For
+        conservation audits: after every stream retires, each remaining
+        allocated block must be explained by exactly its holders (e.g.
+        radix-tree nodes), and a full cache reset must empty this."""
+        return dict(self._ref)

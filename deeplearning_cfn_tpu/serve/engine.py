@@ -51,9 +51,18 @@ the paged step is bit-identical to the dense one, so all parity contracts
 carry over.
 
 An optional **encoder prefix cache** (``prefix_cache_size > 0``, either
-mode) memoizes encoder outputs by padded source tuple: admissions whose
+mode) memoizes encoder outputs by unpadded source tuple: admissions whose
 source was encoded recently scatter the cached rows instead of re-running
 the encoder (LRU, hit/miss/eviction counters in ServeMetrics).
+
+An optional **radix token-prefix KV cache** (``radix_cache``, paged
+co-located engines only) retains finished greedy streams' fully-written
+decoder blocks in a per-source tree (serve/radix.py): a later admission
+with the identical unpadded source shares the matched blocks by refcount
+and resumes decode from the block boundary — O(prompt) decode prefill
+becomes O(unique suffix), token-identical by greedy determinism. LRU
+leaf eviction under pool pressure is tenant-aware and never touches
+blocks still referenced by a running stream.
 
 Search modes per request:
 
@@ -93,7 +102,8 @@ from ..models.decoding import BOS_ID, EOS_ID, PAD_ID
 from ..obs.trace import span
 from .blockpool import BlockAllocator, is_pool_leaf
 from .metrics import ServeMetrics
-from .prefix import PrefixCache
+from .prefix import PrefixCache, unpadded_key
+from .radix import RadixCache
 from .queue import (OverloadError, QosSpec, Request, RequestQueue,
                     RequestState)
 
@@ -124,6 +134,12 @@ class _Group:
     # the per-engine goodput invariant exact: this engine's goodput only
     # counts tokens it decoded itself.
     imported_tokens: int = 0
+    # Radix prefix cache: tokens this group resumed with from cached
+    # blocks (never decoded here — subtracted from the goodput ledger
+    # like imported_tokens) and how many of its bound blocks came shared
+    # from the tree rather than freshly prefilled.
+    radix_hit_tokens: int = 0
+    radix_shared_blocks: int = 0
 
 
 class Engine:
@@ -149,6 +165,7 @@ class Engine:
                  kv_block_size: int = 0,
                  kv_blocks: int = 0,
                  prefix_cache_size: int = 0,
+                 radix_cache: bool = False,
                  speculate_gamma: int = 0,
                  speculate_device: bool = False,
                  draft_model=None,
@@ -330,6 +347,25 @@ class Engine:
             if prefix_cache_size > 0 else None
         if self._prefix is not None:
             self.metrics.configure_prefix_cache(prefix_cache_size)
+        # Radix token-prefix KV cache: finished greedy streams donate
+        # their fully-written decoder blocks to a per-source tree; later
+        # same-source admissions resume from the matched block boundary
+        # instead of re-decoding the prefix (see serve/radix.py).
+        if radix_cache:
+            if not self.paged:
+                raise ValueError(
+                    "radix_cache requires the paged KV path "
+                    "(kv_block_size > 0) — cached prefixes are shared "
+                    "pool blocks")
+            if self.phase != "both":
+                raise ValueError(
+                    "radix_cache is a co-located-engine feature — "
+                    "disaggregated phases hand blocks off instead of "
+                    "retaining them")
+            self.radix = RadixCache(self.kv_block_size)
+            self.metrics.configure_radix()
+        else:
+            self.radix = None
         # Logical source encodes performed (one per admitted request in a
         # miss/uncached admission) — the number the prefix cache shrinks.
         self.encoder_invocations = 0
@@ -559,6 +595,24 @@ class Engine:
             self.draft_variables = self.variables
         if self._prefix is not None:
             self._prefix = PrefixCache(self._prefix.max_entries)
+        # Radix entries are old-weight decoder KV — resuming from them
+        # would splice generations across checkpoints.
+        self.reset_radix_cache()
+
+    def reset_radix_cache(self) -> int:
+        """Drop every radix-cached block (weight swaps, bench sweep
+        boundaries). Returns blocks released; 0 when radix is off."""
+        if self.radix is None:
+            return 0
+        dropped = self.radix.reset(self.allocator)
+        self.metrics.record_radix_evictions("reset", dropped)
+        self._radix_sync_gauges()
+        return dropped
+
+    def _radix_sync_gauges(self) -> None:
+        if self.radix is not None:
+            self.metrics.set_radix_size(self.radix.node_count,
+                                        self.radix.block_count)
 
     @property
     def active_requests(self) -> int:
@@ -669,8 +723,57 @@ class Engine:
             self.allocator.uncommit(group.committed_blocks)
             group.committed_blocks = 0
 
+    def _radix_instant_complete(self, req, tokens: List[int],
+                                now: float) -> None:
+        """A cached stream already covers this request's whole response:
+        admit and release in one motion, consuming no rows and no
+        blocks. The response tokens are host copies of the cached
+        stream; the ledger sees zero decoded work."""
+        group = _Group(req=req, rows=[], budget=req.max_new_tokens)
+        group.radix_hit_tokens = len(tokens)
+        req.state = RequestState.RUNNING
+        req.admitted_at = now
+        if req.preempted_at is not None:
+            req.preempted_s += now - req.preempted_at
+            req.preempted_at = None
+        else:
+            self.metrics.record_admit(now - req.submitted_at)
+        req.tokens = list(tokens)
+        if req.first_token_at is None:
+            req.first_token_at = now
+            self.metrics.record_first_token(req.ttft_s)
+        self.metrics.record_radix_lookup("instant", len(tokens))
+        self._release(group, RequestState.DONE, now)
+
+    def _radix_retire(self, group: _Group, state: RequestState,
+                      now: float) -> None:
+        """Called on release BEFORE the group's blocks go back to the
+        pool: a DONE greedy stream donates its fully-written prefix
+        blocks to the tree (each new node takes its own refcount, so
+        the blocks outlive the group's release). Partial tail blocks
+        are never donated — a later admission re-decodes from the block
+        boundary instead of reading a half-written block."""
+        if not group.rows or group.req.beam_size > 1:
+            return
+        r = group.rows[0]
+        self.metrics.record_radix_blocks(group.radix_shared_blocks,
+                                         len(self._blocks_bound[r]))
+        if state is not RequestState.DONE:
+            return
+        bs = self.kv_block_size
+        full = len(group.req.tokens) // bs
+        if full <= 0:
+            return
+        self.radix.insert(
+            unpadded_key(group.req.src_ids, PAD_ID),
+            group.req.tokens[:full * bs], self._blocks_bound[r][:full],
+            self.allocator, now, tenant=group.req.tenant)
+        self._radix_sync_gauges()
+
     def _release(self, group: _Group, state: RequestState,
                  now: float) -> None:
+        if self.radix is not None:
+            self._radix_retire(group, state, now)
         self._free_group_resources(group)
         group.req.state = state
         group.req.finished_at = now
@@ -687,7 +790,8 @@ class Engine:
         # goodput + wasted == tokens_generated holds per drained engine:
         # tokens a handoff import arrived with were decoded — and
         # ledgered — on the prefill engine, so they are subtracted here.
-        kept = max(0, len(group.req.tokens) - group.imported_tokens)
+        kept = max(0, len(group.req.tokens) - group.imported_tokens
+                   - group.radix_hit_tokens)
         if state is RequestState.DONE:
             self.metrics.record_ledger(
                 goodput=kept, wasted=max(0, group.decoded - kept),
@@ -830,9 +934,26 @@ class Engine:
             # tracks rows handed out earlier in this same admit loop and
             # rows refreshed after a preemption.
             def can_place(req):
-                return (req.beam_size <= len(free)
-                        and self.allocator.can_commit(self._peak_blocks(
-                            req.beam_size, req.max_new_tokens)))
+                if req.beam_size > len(free):
+                    return False
+                peak = self._peak_blocks(req.beam_size,
+                                         req.max_new_tokens)
+                if self.radix is not None:
+                    # Tree-held blocks occupy the pool without backing
+                    # any commitment; evict cold unreferenced leaves
+                    # until this reservation fits. The head's own chain
+                    # is LRU-touched first so cache pressure prefers
+                    # every other cold prefix over the one this very
+                    # admission is about to resume from.
+                    self.radix.lookup(unpadded_key(req.src_ids, PAD_ID),
+                                      now)
+                    evs = self.radix.ensure_free(
+                        self.allocator, peak, tenant=req.tenant)
+                    for cause, n in evs.items():
+                        self.metrics.record_radix_evictions(cause, n)
+                    if evs:
+                        self._radix_sync_gauges()
+                return self.allocator.can_commit(peak)
         while True:
             while free:
                 req = self.queue.pop_ready(now, can_place=can_place)
@@ -844,6 +965,27 @@ class Engine:
                     # line.
                     self.queue.requeue_front(req)
                     break
+                # Radix walk (greedy only; beams own divergent streams).
+                # Greedy decoding is deterministic, so a cached stream
+                # for the identical unpadded source is — token for
+                # token — exactly what this request would generate: if
+                # it already covers the response (EOS or the full
+                # budget inside the cached prefix), complete instantly
+                # with zero rows; otherwise resume decode from the last
+                # fully-cached block boundary.
+                hit_tokens: List[int] = []
+                hit_blocks: List[int] = []
+                if self.radix is not None and w == 1:
+                    hit_tokens, hit_blocks = self.radix.lookup(
+                        unpadded_key(req.src_ids, PAD_ID), now)
+                    lim = min(len(hit_tokens), req.max_new_tokens)
+                    eos = next((i for i in range(lim)
+                                if hit_tokens[i] == EOS_ID), -1)
+                    if eos >= 0 or (lim and lim == req.max_new_tokens):
+                        self._radix_instant_complete(
+                            req, hit_tokens[:eos + 1] if eos >= 0
+                            else hit_tokens[:lim], now)
+                        continue
                 rows, free = free[:w], free[w:]
                 resumed = req.preempted_at is not None
                 for r in rows:
@@ -881,6 +1023,32 @@ class Engine:
                     req.tokens = []
                 else:
                     self.metrics.record_admit(now - req.submitted_at)
+                if hit_tokens:
+                    # Resume from the cached prefix: share the matched
+                    # full blocks by refcount and restart decode at
+                    # position m — the next step writes its KV into a
+                    # FRESH tail block (_bind_rows appends after the
+                    # shared entries), so shared blocks are never
+                    # mutated in place. The resumed tokens count as
+                    # radix hits, not decode work, in the ledger.
+                    m = len(hit_tokens)
+                    r = rows[0]
+                    for b in hit_blocks:
+                        self.allocator.ref(b)
+                    self._blocks_bound[r] = list(hit_blocks)
+                    self._block_tables[r, :len(hit_blocks)] = hit_blocks
+                    req.tokens = list(hit_tokens)
+                    group.steps = m
+                    group.radix_hit_tokens = m
+                    group.radix_shared_blocks = len(hit_blocks)
+                    self._prev[r] = hit_tokens[-1]
+                    self._pos[r] = m
+                    if req.first_token_at is None:
+                        req.first_token_at = now
+                        self.metrics.record_first_token(req.ttft_s)
+                    self.metrics.record_radix_lookup("hit", m)
+                elif self.radix is not None and w == 1:
+                    self.metrics.record_radix_lookup("miss", 0)
             if not self.queue.qos_active:
                 break
             victim = self._pick_victim(now)
@@ -919,7 +1087,7 @@ class Engine:
         for group in admits:
             row_src = np.full((s,), PAD_ID, np.int32)
             row_src[:len(group.req.src_ids)] = group.req.src_ids
-            group_keys.append(tuple(int(t) for t in row_src))
+            group_keys.append(unpadded_key(row_src, PAD_ID))
             for r in group.rows:
                 src[j] = row_src
                 row_targets[j] = r
@@ -934,9 +1102,11 @@ class Engine:
                 jnp.asarray(row_targets))
             self._draft_prefill(src, mask, row_targets)
             return
-        # Prefix-cached prefill: sources are keyed on their padded token
-        # tuple (the exact encoder input, so a hit is bit-identical to
-        # re-encoding). The encoder runs only when at least one admitted
+        # Prefix-cached prefill: sources are keyed on their UNPADDED
+        # token tuple (trailing PAD stripped), so the same prompt at any
+        # pad width hits one entry; encoder padding invariance makes the
+        # cached padded rows bit-identical to re-encoding either way.
+        # The encoder runs only when at least one admitted
         # source missed; hit rows take the cached host copy. Both kinds
         # rejoin the device through the same jitted scatter at the same
         # shapes, so the cache changes nothing compiled. A source admitted

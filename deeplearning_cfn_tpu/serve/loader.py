@@ -69,6 +69,7 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
                 draft_cfg: Union[ExperimentConfig, str, None] = None,
                 quantize: str = "",
                 kv_quant: str = "",
+                radix_cache: bool = False,
                 phase: str = "both",
                 step: int = 0, vocab: str = "", allow_init: bool = False,
                 clock=time.monotonic) -> Tuple[Engine, object, int]:
@@ -90,6 +91,10 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
     the engine quantizes (and re-quantizes on every ``swap_variables``).
     ``kv_quant="int8"`` stores the paged KV pool as int8 codes with
     per-block scales (requires ``kv_block_size > 0``).
+    ``radix_cache=True`` arms the radix token-prefix KV cache — finished
+    greedy streams' block tables are retained and shared with later
+    identical-source requests (requires ``kv_block_size > 0`` and the
+    co-located ``phase="both"``).
     """
     from ..train.run import _workdir_and_ckpt_dir
     from ..train.task import Seq2SeqTask, build_task
@@ -171,6 +176,7 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
         draft_model=draft_model, draft_variables=draft_variables,
         quantize=quantize,
         kv_quant=kv_quant,
+        radix_cache=radix_cache,
         phase=phase,
         clock=clock)
     engine.metrics.ckpt_load_retries = manager.store_retries()

@@ -87,6 +87,13 @@ class ServeMetrics:
         self._prefix_size = 0
         self._prefix_lookups = None
         self._prefix_evictions = None
+        self._radix_lookups = None
+        self._radix_hit_tokens = None
+        self._radix_shared_blocks = None
+        self._radix_request_blocks = None
+        self._radix_evictions = None
+        self._radix_nodes = None
+        self._radix_blocks = None
         self._spec_gamma = 0
         self._spec_proposed = None
         self._spec_accepted = None
@@ -132,6 +139,63 @@ class ServeMetrics:
             "serve_prefix_lookups_total", "prefix cache lookups by result")
         self._prefix_evictions = r.counter(
             "serve_prefix_evictions_total", "prefix cache LRU evictions")
+
+    def configure_radix(self) -> None:
+        """Enable the radix token-prefix KV cache surface (serve_radix_*).
+        Turned on by the engine only when --radix-cache is set, so every
+        other configuration keeps its exact snapshot key set."""
+        if self._radix_lookups is not None:
+            return
+        r = self.registry
+        self._radix_lookups = r.counter(
+            "serve_radix_lookups_total",
+            "radix cache admissions by result (hit/miss/instant)")
+        self._radix_hit_tokens = r.counter(
+            "serve_radix_hit_tokens_total",
+            "decode tokens served from cached prefix blocks")
+        self._radix_shared_blocks = r.counter(
+            "serve_radix_shared_blocks_total",
+            "pool blocks shared from the radix tree, at request release")
+        self._radix_request_blocks = r.counter(
+            "serve_radix_request_blocks_total",
+            "pool blocks bound by released requests (shared + fresh)")
+        self._radix_evictions = r.counter(
+            "serve_radix_evictions_total", "radix evictions by cause")
+        self._radix_nodes = r.gauge(
+            "serve_radix_nodes", "radix tree block nodes resident")
+        self._radix_blocks = r.gauge(
+            "serve_radix_blocks", "pool blocks the radix tree references")
+
+    def record_radix_lookup(self, result: str, matched_tokens: int) -> None:
+        """One admission walk: ``result`` is ``hit`` (resume from cached
+        blocks), ``instant`` (the cached stream already covers the whole
+        response) or ``miss``; ``matched_tokens`` the decode steps the
+        cache saved."""
+        if self._radix_lookups is None:
+            return
+        self._radix_lookups.inc(result=result)
+        if matched_tokens:
+            self._radix_hit_tokens.inc(matched_tokens)
+
+    def record_radix_blocks(self, shared: int, total: int) -> None:
+        """One released request's block provenance: ``shared`` of its
+        ``total`` bound blocks came from the tree (the shared-block
+        ratio's numerator/denominator)."""
+        if self._radix_shared_blocks is None:
+            return
+        if shared:
+            self._radix_shared_blocks.inc(shared)
+        if total:
+            self._radix_request_blocks.inc(total)
+
+    def record_radix_evictions(self, cause: str, n: int) -> None:
+        if self._radix_evictions is not None and n:
+            self._radix_evictions.inc(n, cause=cause)
+
+    def set_radix_size(self, nodes: int, blocks: int) -> None:
+        if self._radix_nodes is not None:
+            self._radix_nodes.set(int(nodes))
+            self._radix_blocks.set(int(blocks))
 
     def configure_speculation(self, gamma: int) -> None:
         """Enable the speculative-decoding metric surface (serve_spec_*)."""
@@ -549,6 +613,54 @@ class ServeMetrics:
         return self.prefix_hits / lookups
 
     @property
+    def radix_hits(self) -> int:
+        """Resumed + instantly-completed admissions (any cached reuse)."""
+        if self._radix_lookups is None:
+            return 0
+        return int(self._radix_lookups.value(result="hit")
+                   + self._radix_lookups.value(result="instant"))
+
+    @property
+    def radix_misses(self) -> int:
+        if self._radix_lookups is None:
+            return 0
+        return int(self._radix_lookups.value(result="miss"))
+
+    @property
+    def radix_hit_rate(self) -> Optional[float]:
+        lookups = self.radix_hits + self.radix_misses
+        if lookups == 0:
+            return None
+        return self.radix_hits / lookups
+
+    @property
+    def radix_hit_tokens(self) -> int:
+        if self._radix_hit_tokens is None:
+            return 0
+        return int(self._radix_hit_tokens.value())
+
+    @property
+    def radix_shared_block_ratio(self) -> Optional[float]:
+        """Fraction of released requests' bound blocks that came shared
+        from the tree instead of freshly prefilled."""
+        if self._radix_request_blocks is None:
+            return None
+        total = self._radix_request_blocks.value()
+        if total == 0:
+            return None
+        return self._radix_shared_blocks.value() / total
+
+    def radix_evictions_by_cause(self) -> Dict[str, int]:
+        if self._radix_evictions is None:
+            return {}
+        out: Dict[str, int] = {}
+        for key, count in self._radix_evictions.series().items():
+            cause = dict(key).get("cause")
+            if cause is not None:
+                out[cause] = int(count)
+        return out
+
+    @property
     def spec_proposed(self) -> int:
         if self._spec_proposed is None:
             return 0
@@ -716,6 +828,27 @@ class ServeMetrics:
             snap["serve_prefix_evictions"] = \
                 int(self._prefix_evictions.value())
             snap["serve_prefix_hit_rate"] = self.prefix_hit_rate
+        if self._radix_lookups is not None:
+            nodes = self._radix_nodes.value()
+            blocks = self._radix_blocks.value()
+            snap["serve_radix_nodes"] = \
+                int(nodes) if nodes is not None else 0
+            snap["serve_radix_blocks"] = \
+                int(blocks) if blocks is not None else 0
+            snap["serve_radix_hits"] = self.radix_hits
+            snap["serve_radix_misses"] = self.radix_misses
+            snap["serve_radix_hit_rate"] = self.radix_hit_rate
+            snap["serve_radix_instant_completes"] = \
+                int(self._radix_lookups.value(result="instant"))
+            snap["serve_radix_hit_tokens"] = self.radix_hit_tokens
+            snap["serve_radix_shared_blocks"] = \
+                int(self._radix_shared_blocks.value())
+            snap["serve_radix_shared_block_ratio"] = \
+                self.radix_shared_block_ratio
+            snap["serve_radix_evictions"] = \
+                int(sum(self._radix_evictions.series().values()))
+            snap["serve_radix_evictions_by_cause"] = \
+                self.radix_evictions_by_cause()
         if self._spec_gamma:
             snap["serve_spec_gamma"] = self._spec_gamma
             snap["serve_spec_proposed"] = self.spec_proposed

@@ -3,10 +3,11 @@
 NMT serving traffic repeats sources (retries, fan-out to multiple decode
 configs, popular sentences), and the engine re-ran the full encoder stack
 for every admission. This is a small host-side LRU over encoder outputs,
-keyed on the **padded source-token tuple** — the exact array the encoder
-would see, so a hit is bit-identical to re-encoding (encoder padding
-invariance already guarantees the value doesn't depend on batch
-neighbours; see docs/SERVING.md).
+keyed on the **unpadded source-token tuple** (trailing PAD stripped), so
+identical prompts arriving at different pad widths hit the same entry.
+Encoder padding invariance guarantees the padded-width [S, H] value is
+the same rows beyond pad either way, so a hit is bit-identical to
+re-encoding (see docs/SERVING.md).
 
 Values are host numpy arrays ([S, H] encoder output rows) — they rejoin
 the device through the same jitted admission scatter the miss path uses,
@@ -17,7 +18,19 @@ metrics mirror (ServeMetrics ``serve_prefix_*``); this class just counts.
 from __future__ import annotations
 
 import collections
-from typing import Hashable, Optional
+from typing import Hashable, Optional, Sequence, Tuple
+
+
+def unpadded_key(tokens: Sequence[int], pad_id: int) -> Tuple[int, ...]:
+    """Canonical cache key: the token tuple with trailing padding
+    stripped, so identical prompts arriving at different pad widths
+    (explicitly padded or not, engines with different max_src_len)
+    collide on the same entry. Interior padding is preserved — only the
+    trailing run is cosmetic."""
+    n = len(tokens)
+    while n > 0 and int(tokens[n - 1]) == pad_id:
+        n -= 1
+    return tuple(int(t) for t in tokens[:n])
 
 
 class PrefixCache:

@@ -1,0 +1,408 @@
+"""Radix token-prefix KV cache tests.
+
+Three layers, mirroring the feature's own: the RadixCache structure over
+a bare BlockAllocator (lookup/insert/LRU eviction/tenant isolation — no
+JAX), the engine integration on the tiny NMT model (the token-parity
+contract: a radix engine's output must be byte-identical to a cold-cache
+engine across repeated sources, divergent budgets, instant completes and
+pool-pressure eviction, with refcount conservation throughout), and the
+fleet's prefix-affinity routing (rendezvous placement stability under
+membership churn, and cache locality through a real two-replica router).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.fleet import EngineReplica, Router
+from deeplearning_cfn_tpu.fleet.router import PrefixAffinityPolicy
+from deeplearning_cfn_tpu.models import decoding
+from deeplearning_cfn_tpu.models.transformer_nmt import transformer_nmt_tiny
+from deeplearning_cfn_tpu.serve.blockpool import BlockAllocator
+from deeplearning_cfn_tpu.serve.engine import Engine
+from deeplearning_cfn_tpu.serve.metrics import ServeMetrics
+from deeplearning_cfn_tpu.serve.queue import OverloadError
+from deeplearning_cfn_tpu.serve.radix import RadixCache
+
+# -- RadixCache over a bare allocator (no JAX) -------------------------------
+
+BS = 4
+
+
+def _chain(cache, alloc, src, tokens, tenant=None, now=0.0):
+    """Allocate fully-written blocks for ``tokens`` (a multiple of BS)
+    and insert them, the way the engine does on a DONE stream."""
+    blocks = [alloc.alloc() for _ in range(len(tokens) // BS)]
+    cache.insert(tuple(src), list(tokens), blocks, alloc, now,
+                 tenant=tenant)
+    # The finished stream retires: its own references go away and the
+    # tree's survive, exactly the engine's release order.
+    for b in blocks:
+        alloc.free(b)
+    return blocks
+
+
+def test_radix_lookup_miss_then_insert_roundtrip():
+    alloc = BlockAllocator(num_blocks=9, block_size=BS)
+    cache = RadixCache(BS)
+    assert cache.lookup((1, 2), now=0.0) == ([], [])
+    toks = list(range(10, 18))            # two full blocks
+    blocks = _chain(cache, alloc, (1, 2), toks, now=1.0)
+    assert cache.source_count == 1
+    assert cache.node_count == 2 and cache.block_count == 2
+    got_t, got_b = cache.lookup((1, 2), now=2.0)
+    assert got_t == toks and got_b == blocks
+    # A different source shares nothing.
+    assert cache.lookup((9, 9), now=2.0) == ([], [])
+    # Tree-exclusive accounting: the chain is held only by the tree.
+    assert cache.tree_exclusive_blocks(alloc) == 2
+    for b in blocks:
+        assert alloc.refcount(b) == 1
+
+
+def test_radix_insert_existing_segments_not_double_referenced():
+    """Re-inserting a chain (a concurrent same-source finisher) touches
+    existing nodes instead of creating duplicates or leaking refs — the
+    duplicate blocks stay owned by their finisher."""
+    alloc = BlockAllocator(num_blocks=9, block_size=BS)
+    cache = RadixCache(BS)
+    toks = list(range(20, 28))
+    blocks = _chain(cache, alloc, (5,), toks, now=1.0)
+    dup = [alloc.alloc() for _ in range(2)]
+    created = cache.insert((5,), toks, dup, alloc, now=2.0)
+    assert created == 0
+    assert cache.node_count == 2
+    # The duplicates were NOT referenced by the tree; freeing them (as
+    # their finisher would) must empty them out of the pool.
+    for b in dup:
+        assert alloc.refcount(b) == 1
+        alloc.free(b)
+    for b in blocks:
+        assert alloc.refcount(b) == 1
+
+
+def test_radix_ensure_free_evicts_lru_exclusive_leaves():
+    alloc = BlockAllocator(num_blocks=7, block_size=BS)  # 6 usable
+    cache = RadixCache(BS)
+    _chain(cache, alloc, (1,), list(range(8)), now=1.0)   # cold chain
+    _chain(cache, alloc, (2,), list(range(8)), now=5.0)   # hot chain
+    assert cache.tree_exclusive_blocks(alloc) == 4
+    # Committing 4 blocks needs 4 + tree(4) <= 6 → evict 2, coldest
+    # leaves first (deepest node of the LRU chain goes before its
+    # parent).
+    evicted = cache.ensure_free(alloc, need=4)
+    assert evicted == {"pressure": 2}
+    assert cache.source_count == 1
+    assert cache.lookup((1,), now=6.0) == ([], [])         # cold gone
+    assert len(cache.lookup((2,), now=6.0)[1]) == 2        # hot intact
+    assert alloc.committed_blocks + 4 \
+        + cache.tree_exclusive_blocks(alloc) <= alloc.usable_blocks
+
+
+def test_radix_ensure_free_prefers_own_tenant_then_crosses():
+    alloc = BlockAllocator(num_blocks=5, block_size=BS)  # 4 usable
+    cache = RadixCache(BS)
+    # tenant-b's chain is COLDER, but tenant-a's pressure must consume
+    # tenant-a's own leaf first.
+    _chain(cache, alloc, (1,), list(range(4)), tenant="b", now=1.0)
+    _chain(cache, alloc, (2,), list(range(4)), tenant="a", now=9.0)
+    ev1 = cache.ensure_free(alloc, need=3, tenant="a")
+    assert ev1 == {"pressure": 1}
+    assert cache.lookup((2,), now=10.0) == ([], [])   # a's own went
+    assert len(cache.lookup((1,), now=10.0)[1]) == 1  # b's survived
+    # Only cross-tenant leaves remain — last resort, labeled as such.
+    ev2 = cache.ensure_free(alloc, need=4, tenant="a")
+    assert ev2 == {"cross_tenant_pressure": 1}
+    assert cache.source_count == 0
+    assert cache.evictions == {"pressure": 1, "cross_tenant_pressure": 1}
+
+
+def test_radix_never_evicts_blocks_referenced_by_running_streams():
+    alloc = BlockAllocator(num_blocks=3, block_size=BS)  # 2 usable
+    cache = RadixCache(BS)
+    blocks = _chain(cache, alloc, (1,), list(range(8)), now=1.0)
+    # A running stream holds the chain (the engine's resume path refs
+    # every matched block).
+    for b in blocks:
+        alloc.ref(b)
+    assert cache.tree_exclusive_blocks(alloc) == 0
+    evicted = cache.ensure_free(alloc, need=2)
+    assert evicted == {}                  # nothing evictable — pinned
+    assert cache.node_count == 2
+    for b in blocks:
+        assert alloc.refcount(b) == 2
+
+
+def test_radix_reset_releases_every_tree_reference():
+    alloc = BlockAllocator(num_blocks=9, block_size=BS)
+    cache = RadixCache(BS)
+    _chain(cache, alloc, (1,), list(range(8)), now=1.0)
+    _chain(cache, alloc, (2,), list(range(4)), now=2.0)
+    assert alloc.blocks_in_use == 3
+    dropped = cache.reset(alloc)
+    assert dropped == 3
+    assert cache.source_count == 0 and cache.node_count == 0
+    assert alloc.blocks_in_use == 0
+    assert cache.evictions["reset"] == 3
+
+
+def test_radix_metrics_keys_are_conditional():
+    """An unconfigured ServeMetrics snapshot has NO serve_radix_ keys
+    (the pinned obs contract); configure_radix adds the whole surface."""
+
+    class _Clock:
+        def __call__(self):
+            return 0.0
+
+    base = ServeMetrics(capacity=2, clock=_Clock())
+    assert not any(k.startswith("serve_radix_") for k in base.snapshot())
+    m = ServeMetrics(capacity=2, clock=_Clock())
+    m.configure_radix()
+    m.record_radix_lookup("miss", 0)
+    m.record_radix_lookup("hit", 8)
+    m.record_radix_lookup("instant", 4)
+    m.record_radix_blocks(2, 3)
+    m.record_radix_evictions("pressure", 2)
+    m.set_radix_size(nodes=5, blocks=5)
+    snap = m.snapshot()
+    assert snap["serve_radix_nodes"] == 5
+    assert snap["serve_radix_blocks"] == 5
+    assert snap["serve_radix_hits"] == 2          # hit + instant
+    assert snap["serve_radix_misses"] == 1
+    assert snap["serve_radix_hit_rate"] == pytest.approx(2 / 3)
+    assert snap["serve_radix_instant_completes"] == 1
+    assert snap["serve_radix_hit_tokens"] == 12
+    assert snap["serve_radix_shared_blocks"] == 2
+    assert snap["serve_radix_shared_block_ratio"] == pytest.approx(2 / 3)
+    assert snap["serve_radix_evictions"] == 2
+    assert snap["serve_radix_evictions_by_cause"] == {"pressure": 2}
+
+
+# -- engine integration: the token-parity contract ---------------------------
+
+SCHED_VOCAB = 64
+SCHED_SRC_LEN = 8
+
+
+@pytest.fixture(scope="module")
+def sched_model():
+    model = transformer_nmt_tiny(vocab_size=SCHED_VOCAB, hidden_size=32,
+                                 num_layers=1, num_heads=2, mlp_dim=64,
+                                 max_len=32)
+    variables = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, SCHED_SRC_LEN), np.int32),
+        np.ones((1, SCHED_SRC_LEN), np.int32),
+        np.zeros((1, SCHED_SRC_LEN), np.int32), train=False)
+    return model, {"params": variables["params"]}
+
+
+def _mk_engine(sched_model, radix=True, **kw):
+    model, variables = sched_model
+    kw.setdefault("capacity", 2)
+    kw.setdefault("max_src_len", SCHED_SRC_LEN)
+    kw.setdefault("queue_depth", 32)
+    kw.setdefault("kv_block_size", 4)
+    return Engine(model, variables, radix_cache=radix, **kw)
+
+
+def _src(seed, n=5):
+    rng = np.random.RandomState(seed)
+    return [int(t) for t in rng.randint(3, SCHED_VOCAB, size=n - 1)] + \
+        [decoding.EOS_ID]
+
+
+def _decode_all(eng, trace):
+    """Submit (src, budget, beam) triples with backpressure, drain, and
+    return the per-trace-index token lists."""
+    ids = []
+    for src, budget, beam in trace:
+        while True:
+            try:
+                ids.append(eng.submit(src, max_new_tokens=budget,
+                                      beam_size=beam).id)
+                break
+            except OverloadError:
+                eng.step()
+    eng.run_until_drained()
+    return [list(eng.poll(i).tokens) for i in ids]
+
+
+# The divergent-budget trace: repeats of two sources at budgets shorter
+# than, equal to, and longer than what the cache holds — instant
+# completes, block-boundary resumes, and the copy-on-write tail all in
+# one pass.
+def _parity_trace():
+    s0, s1 = _src(1), _src(2)
+    return [(s0, 8, 1), (s1, 6, 1), (s0, 4, 1), (s0, 8, 1),
+            (s1, 6, 1), (s0, 12, 1), (s1, 3, 1), (s0, 8, 1)]
+
+
+@pytest.mark.parametrize("kv_quant", ["", "int8"])
+def test_radix_token_parity_vs_cold_cache(sched_model, kv_quant):
+    trace = _parity_trace()
+    cold = _decode_all(
+        _mk_engine(sched_model, radix=False, kv_quant=kv_quant), trace)
+    eng = _mk_engine(sched_model, kv_quant=kv_quant)
+    warm = _decode_all(eng, trace)
+    assert warm == cold
+    snap = eng.metrics.snapshot()
+    assert snap["serve_radix_hits"] > 0
+    assert snap["serve_radix_instant_completes"] > 0
+    assert snap["serve_radix_hit_tokens"] > 0
+    assert eng.metrics.radix_hit_rate > 0
+
+
+def test_radix_beam_requests_bypass_the_tree(sched_model):
+    """Beam groups neither read nor populate the tree (their block
+    tables fork), but greedy traffic around them still shares — and
+    every token matches the cold engine. Driven one request at a time so
+    the hit/miss ledger is deterministic (concurrent same-source misses
+    are legal but unpredictable)."""
+    s0 = _src(3)
+    trace = [(s0, 6, 2), (s0, 6, 1), (s0, 6, 1), (s0, 6, 2)]
+
+    def _sequential(engine):
+        out = []
+        for src, budget, beam in trace:
+            rid = engine.submit(src, max_new_tokens=budget,
+                                beam_size=beam).id
+            engine.run_until_drained()
+            out.append(list(engine.poll(rid).tokens))
+        return out
+
+    cold = _sequential(_mk_engine(sched_model, radix=False))
+    eng = _mk_engine(sched_model)
+    warm = _sequential(eng)
+    assert warm == cold
+    snap = eng.metrics.snapshot()
+    # Two greedy admissions: one miss (inserts), one cached reuse.
+    assert snap["serve_radix_hits"] == 1
+    assert snap["serve_radix_misses"] == 1
+    assert eng.radix.source_count == 1
+
+
+def test_radix_engine_requires_paged_colocated(sched_model):
+    model, variables = sched_model
+    with pytest.raises(ValueError):
+        Engine(model, variables, capacity=2, max_src_len=SCHED_SRC_LEN,
+               radix_cache=True, kv_block_size=0)
+    with pytest.raises(ValueError):
+        Engine(model, variables, capacity=2, max_src_len=SCHED_SRC_LEN,
+               radix_cache=True, kv_block_size=4, phase="prefill")
+
+
+def test_radix_eviction_under_pool_pressure(sched_model):
+    """A pool too small for the whole working set forces ensure_free to
+    evict cold chains at admission — and decoding stays correct and
+    complete throughout (no drops, token parity, invariant holds)."""
+    trace = [(_src(10 + i), 8, 1) for i in range(5)]
+    cold = _decode_all(
+        _mk_engine(sched_model, radix=False, capacity=1, kv_blocks=8),
+        trace)
+    eng = _mk_engine(sched_model, capacity=1, kv_blocks=8)
+    warm = _decode_all(eng, trace)
+    assert warm == cold
+    assert eng.radix.evictions.get("pressure", 0) > 0
+    alloc = eng.allocator
+    assert (alloc.committed_blocks + eng.radix.tree_exclusive_blocks(alloc)
+            <= alloc.usable_blocks)
+    assert eng.metrics.snapshot()["serve_radix_evictions"] > 0
+
+
+def test_radix_refcount_conservation_and_reset(sched_model):
+    """After drain, every live pool block is a tree block with exactly
+    one reference (no leaks, no double-refs); reset returns the pool to
+    empty."""
+    eng = _mk_engine(sched_model)
+    _decode_all(eng, _parity_trace())
+    alloc = eng.allocator
+    refs = alloc.refcounts()
+    assert len(refs) == eng.radix.block_count
+    assert all(c == 1 for c in refs.values())
+    assert eng.radix.tree_exclusive_blocks(alloc) == eng.radix.block_count
+    dropped = eng.reset_radix_cache()
+    assert dropped > 0
+    assert alloc.blocks_in_use == 0
+    assert eng.radix.source_count == 0
+    snap = eng.metrics.snapshot()
+    assert snap["serve_radix_evictions_by_cause"]["reset"] == dropped
+    assert snap["serve_radix_nodes"] == 0 and snap["serve_radix_blocks"] == 0
+
+
+def test_radix_cache_is_dropped_on_weight_swap(sched_model):
+    """swap_variables invalidates every cached stream — the old weights'
+    tokens are not prefixes of the new weights' decodes."""
+    model, variables = sched_model
+    eng = _mk_engine(sched_model)
+    _decode_all(eng, [(_src(1), 8, 1)])
+    assert eng.radix.source_count == 1
+    eng.swap_variables(variables)
+    assert eng.radix.source_count == 0
+    assert eng.allocator.blocks_in_use == 0
+
+
+# -- prefix-affinity routing -------------------------------------------------
+
+
+def _cands(ids):
+    return [(rid, {}) for rid in sorted(ids)]
+
+
+def test_prefix_affinity_is_deterministic_and_key_sticky():
+    pol = PrefixAffinityPolicy()
+    ids = [f"replica-{i}" for i in range(3)]
+    first = pol.order_for(_cands(ids), "grp-0")
+    assert sorted(first) == ids
+    for _ in range(3):
+        assert pol.order_for(_cands(ids), "grp-0") == first
+    # Keyless requests fall back to the load order untouched.
+    assert pol.order_for(_cands(ids), None) == pol.order(_cands(ids))
+
+
+def test_prefix_affinity_churn_remaps_only_the_removed_replicas_keys():
+    """Rendezvous hashing's stability contract: removing one replica
+    remaps ONLY the keys that preferred it — every other key's placement
+    survives the membership change (no thundering re-hash)."""
+    pol = PrefixAffinityPolicy()
+    ids = [f"replica-{i}" for i in range(3)]
+    keys = [f"grp-{i}" for i in range(30)]
+    before = {k: pol.order_for(_cands(ids), k)[0] for k in keys}
+    assert set(before.values()) == set(ids)   # all replicas drew keys
+    survivors = [r for r in ids if r != "replica-1"]
+    after = {k: pol.order_for(_cands(survivors), k)[0] for k in keys}
+    for k in keys:
+        if before[k] == "replica-1":
+            assert after[k] in survivors
+        else:
+            assert after[k] == before[k]
+
+
+def test_router_prefix_affinity_colocates_groups_on_real_engines(
+        sched_model):
+    """End to end: same affinity key → same replica → radix reuse on
+    that replica; and keyless same-source requests derive a token-based
+    key that colocates them just the same."""
+    # capacity=1 so same-source requests admit one at a time — the
+    # hit ledger is then exactly one cold miss + three reuses.
+    reps = [EngineReplica(f"replica-{i}", _mk_engine(sched_model,
+                                                     capacity=1))
+            for i in range(2)]
+    router = Router(reps, policy="prefix_affinity")
+    s = _src(7)
+    rids = [router.submit(s, max_new_tokens=4, affinity_key="grp-0")
+            for _ in range(4)]
+    router.run_until_drained()
+    results = [router.result(r) for r in rids]
+    assert all(r["state"] == "done" for r in results)
+    assert len({tuple(r["tokens"]) for r in results}) == 1
+    placed = {router._requests[r].replica_id for r in rids}
+    assert len(placed) == 1
+    rep = next(rp for rp in reps if rp.id in placed)
+    # One cold decode, three cached reuses — all on the one replica.
+    assert rep.engine.metrics.radix_hits == 3
+    # Keyless: the router derives the affinity key from the leading
+    # source tokens, so bare repeats of one prompt still colocate.
+    s2 = _src(8)
+    rids2 = [router.submit(s2, max_new_tokens=4) for _ in range(3)]
+    router.run_until_drained()
+    assert len({router._requests[r].replica_id for r in rids2}) == 1

@@ -300,6 +300,20 @@ def test_prefix_cache_hit_miss_and_lru_eviction():
         PrefixCache(0)
 
 
+def test_unpadded_key_strips_trailing_pad_only():
+    """The encoder LRU key ignores pad WIDTH, not pad POSITION: the same
+    sentence padded to any width maps to one key, while interior pads
+    (a different sentence) stay significant."""
+    from deeplearning_cfn_tpu.serve.prefix import unpadded_key
+
+    pad = decoding.PAD_ID
+    base = [5, 9, 2]
+    keys = {unpadded_key(base + [pad] * w, pad) for w in (0, 1, 3)}
+    assert keys == {(5, 9, 2)}
+    assert unpadded_key([5, pad, 2], pad) == (5, pad, 2)
+    assert unpadded_key([pad, pad], pad) == ()
+
+
 # -- engine: shared tiny model ----------------------------------------------
 
 SCHED_VOCAB = 64
@@ -878,6 +892,21 @@ def test_paged_prefix_cache_reuses_encoder_outputs(sched_model):
     assert snap["serve_kv_blocks_total"] == eng.allocator.usable_blocks
 
 
+def test_prefix_cache_hits_across_pad_widths(sched_model):
+    """One sentence submitted at two pad widths is ONE cache entry: the
+    LRU is keyed on the unpadded token tuple, so client-side padding
+    differences can't split (and silently cold-miss) the cache."""
+    eng = _mk_engine(sched_model, capacity=1, queue_depth=16,
+                     prefix_cache_size=8)
+    s = _src(1, n=5)
+    for padded in (s, s + [decoding.PAD_ID], s + [decoding.PAD_ID] * 3):
+        eng.submit(padded, max_new_tokens=4)
+        eng.run_until_drained()
+    assert eng.metrics.prefix_hits == 2
+    assert eng.metrics.prefix_misses == 1
+    assert eng._prefix.hits == 2 and len(eng._prefix) == 1
+
+
 def test_prefix_cache_eviction_keeps_correctness(sched_model):
     """A 1-entry cache under alternating sources evicts constantly and
     must still be output-identical to the uncached engine."""
@@ -925,7 +954,8 @@ def test_serve_metrics_paged_keys_are_conditional():
     pinned obs contract); configuring the surfaces adds them."""
     base = ServeMetrics(capacity=2, clock=FakeClock())
     snap = base.snapshot()
-    assert not any(k.startswith(("serve_kv_", "serve_prefix_"))
+    assert not any(k.startswith(("serve_kv_", "serve_prefix_",
+                                 "serve_radix_"))
                    for k in snap)
     m = ServeMetrics(capacity=2, clock=FakeClock())
     m.configure_kv_pool(usable_blocks=8, block_size=4)
