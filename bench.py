@@ -224,7 +224,10 @@ def _finalize_green(record: dict, alive: bool, probe_note: str,
                     "prefill_tokens_saved_ratio",
                     "radix_hit_rate", "radix_sweep",
                     "radix_hit_rate_prefix_affinity",
-                    "radix_hit_rate_round_robin"):
+                    "radix_hit_rate_round_robin",
+                    "prefill_chunk", "chunked_decode_p95",
+                    "unchunked_decode_p95",
+                    "chunk_ticks_per_prefill_p50"):
             if key in record:
                 record[key] = None
     return record

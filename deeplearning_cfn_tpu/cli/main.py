@@ -359,6 +359,14 @@ def _cmd_bench(args) -> int:
               "table (drop --fleet-prefill/--fleet-decode)",
               file=sys.stderr)
         return 2
+    if getattr(args, "prefill_chunk", 0) \
+            and (getattr(args, "fleet_prefill", 0)
+                 or getattr(args, "fleet_decode", 0)):
+        print("[dlcfn-tpu] --prefill-chunk is the co-located answer to "
+              "prefill-induced decode stall — disaggregated phases "
+              "already split prefill off the decode tick (drop "
+              "--fleet-prefill/--fleet-decode)", file=sys.stderr)
+        return 2
     if getattr(args, "fleet", False):
         if getattr(args, "ops", None) or args.collectives or \
                 getattr(args, "sweep_batches", None) or \
@@ -391,7 +399,9 @@ def _cmd_bench(args) -> int:
                                trace_spec=args.trace,
                                autoscale=args.autoscale,
                                min_replicas=args.min_replicas,
-                               max_replicas=args.max_replicas)
+                               max_replicas=args.max_replicas,
+                               prefill_chunk=getattr(
+                                   args, "prefill_chunk", 0))
         print(json.dumps(line))
         return 0
     if getattr(args, "obs_smoke", False):
@@ -537,6 +547,7 @@ def _cmd_serve(args) -> int:
             draft_cfg=args.draft or None,
             quantize=args.quantize, kv_quant=args.kv_quant,
             radix_cache=args.radix_cache,
+            prefill_chunk=getattr(args, "prefill_chunk", 0),
             step=args.step, vocab=args.vocab, allow_init=args.allow_init)
     except (FileNotFoundError, ValueError) as e:
         print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
@@ -722,6 +733,8 @@ def _fleet_build_replicas(args, n: int, specs=None, kv_block_size: int = 0):
             kv_quant=getattr(args, "kv_quant", ""),
             radix_cache=radix and phase == "both",
             phase=phase,
+            prefill_chunk=getattr(args, "prefill_chunk", 0)
+            if phase == "both" else 0,
             vocab=args.vocab, allow_init=args.allow_init)
         replicas.append(EngineReplica(name, engine))
     return replicas, bpe, at_step
@@ -905,6 +918,8 @@ def _cmd_fleet_up(args) -> int:
             argv += ["--kv-quant", args.kv_quant]
         if getattr(args, "radix_cache", False):
             argv += ["--radix-cache"]
+        if getattr(args, "prefill_chunk", 0):
+            argv += ["--prefill-chunk", str(args.prefill_chunk)]
         if args.accelerator:
             argv += ["--accelerator", args.accelerator]
         if args.vocab:
@@ -1632,6 +1647,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "refcounted radix tree and shared with later "
                          "identical-source requests (resume or instant-"
                          "complete); needs --kv-block-size > 0")
+    sv.add_argument("--prefill-chunk", type=int, default=0,
+                    help="chunked prefill: admission source encode "
+                         "proceeds this many tokens per engine tick, "
+                         "interleaved with the fused decode window, so "
+                         "a long prompt never stalls co-resident "
+                         "streams (0 = one-shot prefill; token output "
+                         "unchanged)")
     sv.add_argument("--speculate", type=int, default=0,
                     help="speculative decoding: draft tokens proposed per "
                          "verify step (0 = off); self-draft without a "
@@ -1712,6 +1734,11 @@ def build_parser() -> argparse.ArgumentParser:
                             "replicas only — pair with the "
                             "prefix_affinity policy to keep repeats on "
                             "one replica's cache)")
+        p.add_argument("--prefill-chunk", type=int, default=0,
+                       help="per-replica chunked prefill: admission "
+                            "encode proceeds this many source tokens "
+                            "per tick interleaved with decode "
+                            "(co-located replicas only; 0 = one-shot)")
         p.add_argument("--vocab", default="",
                        help="BPE vocab.json — required for \"text\" "
                             "requests")
@@ -1942,6 +1969,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "record gains radix_hit_rate / "
                          "radix_hit_tokens_per_request / "
                          "prefill_tokens_saved_ratio)")
+    be.add_argument("--prefill-chunk", type=int, default=0,
+                    help="fleet scenario: per-replica chunked prefill "
+                         "quota in source tokens per tick (co-located "
+                         "replicas only; 0 = one-shot) — the record "
+                         "gains the chunked-vs-unchunked decode-p95 "
+                         "pair and token_identical_unchunked")
     be.add_argument("--fleet-chaos-step", type=int, default=0,
                     help="fleet scenario: crash-inject replica-0 on its "
                          "Nth decode step (0 = off) — the chaos variant "

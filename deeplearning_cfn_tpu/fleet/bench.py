@@ -184,7 +184,8 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
                     autoscale: bool = False,
                     min_replicas: int = 1,
                     max_replicas: int = 0,
-                    tick_s: float = 0.05) -> Dict:
+                    tick_s: float = 0.05,
+                    prefill_chunk: int = 0) -> Dict:
     """Route the fixed trace across the fleet to drain; return the
     BENCH-contract record with the fleet fields. ``smoke`` shrinks the
     scenario AND runs the single-engine parity baseline (the t1.sh gate
@@ -248,7 +249,18 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
     (and ``<trace_dir>/autoscale.jsonl``). The contract: scale-up on
     the burst onset, drain-based scale-down in the trough,
     ``dropped_requests == 0``, and ``token_identical`` against a
-    FIXED fleet of ``max_replicas`` replaying the same schedule."""
+    FIXED fleet of ``max_replicas`` replaying the same schedule.
+
+    ``prefill_chunk > 0`` arms Sarathi-style chunked prefill on every
+    co-located replica (engine ``--prefill-chunk``). Outside replay/
+    chaos runs the record then carries the stall-free contract pair —
+    the SAME trace through a fresh UNCHUNKED fleet in the same
+    invocation (``token_identical_unchunked``, ``chunked_decode_p95``
+    vs ``unchunked_decode_p95``) — and, under
+    ``trace_mix='prefill-heavy'``, a no-adversary baseline over the
+    warmed chunked members (``decode_p95_no_adversary``): the
+    co-located form of the contract disaggregation pinned, without a
+    split fleet."""
     import jax
 
     from ..models.transformer_nmt import transformer_nmt_tiny
@@ -263,6 +275,13 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
                          "prefix-heavy"):
         raise ValueError(f"unknown trace mix {trace_mix!r}")
     disagg = prefill_replicas > 0
+    if prefill_chunk < 0:
+        raise ValueError(
+            f"prefill_chunk must be >= 0, got {prefill_chunk}")
+    if prefill_chunk > 0 and disagg:
+        raise ValueError("chunked prefill needs co-located replicas "
+                         "(phase='both'): disaggregated phases already "
+                         "split prefill off the decode tick")
     if radix and disagg:
         raise ValueError("the radix cache needs co-located replicas "
                          "(phase='both'): a split prefill/decode stream "
@@ -362,7 +381,11 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         return _clock_ref[0].read() if _clock_ref[0] is not None \
             else time.monotonic()
 
-    def _build_fleet(specs, plan):
+    def _build_fleet(specs, plan, chunk=None):
+        # ``chunk`` overrides the fleet-wide prefill_chunk (the chunked
+        # contract block builds an UNCHUNKED comparison fleet with 0);
+        # disaggregated phases never chunk (the engine rejects it).
+        chunk = prefill_chunk if chunk is None else chunk
         built: List[EngineReplica] = []
         warm: Dict[str, int] = {}
         for name, phase in specs:
@@ -377,6 +400,7 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
                             kv_quant=kv_quant,
                             radix_cache=radix,
                             phase=phase,
+                            prefill_chunk=chunk if phase == "both" else 0,
                             clock=_fleet_clock)
             rep = EngineReplica(name, engine, fault_plan=plan)
             # Warmup per replica, outside the timed window (each engine
@@ -387,6 +411,18 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
             # first stream's decode_s and poisons the p95 contract.
             warm_req = engine.submit(
                 pairs[0][0], max_new_tokens=max_new_tokens)
+            if chunk > 0 and phase == "both" and slots >= 2:
+                # Chunked engines drop to window-1 fused steps whenever
+                # a partial prefill coexists with decode — a shape one
+                # warm request never exercises (its own chunk ticks
+                # have nothing decoding yet). Overlap a second warm
+                # prompt: the quota drains heads in order, so the first
+                # finishes encoding and decodes window-1 while the
+                # second is still partial — compiling that variant
+                # here instead of inside the first timed stream's
+                # decode_s.
+                engine.submit(pairs[0][0],
+                              max_new_tokens=max_new_tokens)
             engine.run_until_drained()
             if phase == "prefill" and engine.handoff_ready(warm_req.id):
                 # Prefill engines park instead of finishing — free the
@@ -788,6 +824,12 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         "radix_prefill_monotonic": None,
         "radix_hit_rate_prefix_affinity": None,
         "radix_hit_rate_round_robin": None,
+        # -- chunked prefill (None when --prefill-chunk is off) --------
+        "prefill_chunk": prefill_chunk if prefill_chunk > 0 else None,
+        "token_identical_unchunked": None,
+        "chunked_decode_p95": None,
+        "unchunked_decode_p95": None,
+        "chunk_ticks_per_prefill_p50": None,
         # -- open-loop replay / closed-loop autoscale -----------------
         "trace_spec": trace_spec,
         "autoscale": autoscale,
@@ -881,6 +923,52 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
             round(h_aff / lk_aff, 4) if lk_aff else None)
         record["radix_hit_rate_round_robin"] = (
             round(h_rr / lk_rr, 4) if lk_rr else None)
+
+    if prefill_chunk > 0 and not disagg and trace_spec is None \
+            and chaos_kill_step == 0:
+        # The stall-free contract, co-located form: the SAME trace
+        # through a fresh UNCHUNKED fleet of the same size, in the same
+        # invocation. Token parity proves chunking changes nothing (the
+        # completion tick re-runs the full-width prefill, so outputs
+        # are bit-identical by construction); the decode-p95 pair
+        # quantifies the admission stall the chunk quota removes —
+        # visible under the prefill-heavy mix, where a long adversary
+        # prompt otherwise monopolises the admission encode.
+        un_specs = [(f"unchunked-{i}", "both")
+                    for i in range(len(members))]
+        un_members, _ = _build_fleet(un_specs, None, chunk=0)
+        un_router = Router(un_members, policy=policy)
+        un_rids, _ = _drive(un_router, pairs, tags=qos_tags)
+        un_results = [un_router.result(rid) for rid in un_rids]
+        record["token_identical_unchunked"] = (
+            [r["tokens"] for r in results]
+            == [r["tokens"] for r in un_results])
+        record["chunked_decode_p95"] = _decode_p95(router, rids, pairs)
+        record["unchunked_decode_p95"] = _decode_p95(
+            un_router, un_rids, pairs)
+        # How many chunk ticks each source encode took, from the
+        # router's honest phase ledger (prefill_chunks accumulates
+        # across preempt/resume attempts, so this is per-request truth,
+        # not a per-engine histogram).
+        ticks_per = [
+            router.ledger[rid]["phases"]["prefill_chunks"]
+            for rid in rids
+            if rid in router.ledger
+            and "prefill_chunks" in router.ledger[rid]["phases"]]
+        record["chunk_ticks_per_prefill_p50"] = percentile(ticks_per, 50)
+        if trace_mix == "prefill-heavy":
+            # The no-adversary baseline: the SAME warmed chunked fleet,
+            # fresh router, latency streams only. "chunked decode p95
+            # flat vs this number" is the pinned stall-free contract —
+            # the co-located analogue of the disagg block below.
+            streams = [p for p in pairs if p[1] == max_new_tokens]
+            base_router = Router(members, policy=policy)
+            base_rids, _ = _drive(base_router, streams,
+                                  rid_prefix="noadv-")
+            for rid in base_rids:
+                base_router.result(rid)
+            record["decode_p95_no_adversary"] = _decode_p95(
+                base_router, base_rids, streams)
 
     if disagg:
         # The contract run: the SAME trace through a co-located paged

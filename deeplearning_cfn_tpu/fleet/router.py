@@ -758,6 +758,13 @@ class Router:
             # Same conditionality as handoff_s: only streams that were
             # actually evicted carry the parked-time phase.
             phases["preempted_s"] = preempted_s
+        chunks = getattr(req, "prefill_chunks", 0) or 0
+        if chunks > 0:
+            # Chunked prefill: how many chunk ticks the source encode
+            # took. prefill_s above already sums those ticks, and
+            # queue_wait ends at admission — the same tick the first
+            # chunk ran — so the phase split stays honest.
+            phases["prefill_chunks"] = int(chunks)
         self.ledger[lr.rid] = {
             "request_id": lr.rid, "state": state,
             "attempts": lr.attempts, "replicas": list(lr.hops),

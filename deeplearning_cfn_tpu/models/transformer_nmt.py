@@ -117,6 +117,19 @@ class TransformerNMT(nn.Module):
             x = lyr(x, self_bias=enc_bias, deterministic=det)
         return self.enc_norm(x)
 
+    def encode_partial(self, src_ids, src_mask, train: bool = False):
+        """Chunked-prefill partial encode: the same computation as
+        :meth:`encode` over a prefix-truncated source (tokens past the
+        serving engine's chunk cursor replaced by PAD, mask truncated to
+        match). The encoder is bidirectional, so the output rows are
+        PROVISIONAL — a prefix refined every chunk tick, valid only as
+        long as nothing attends it; the engine re-runs the full-source
+        :meth:`encode` at chunk completion, which is what makes chunked
+        prefill bit-identical to the one-shot path. Kept as a distinct
+        method so the engine's partial-encode jit is its own compiled
+        variant (and so profiles/traces attribute chunk work)."""
+        return self.encode(src_ids, src_mask, train=train)
+
     def decode(self, tgt_in_ids, enc, src_mask, train: bool = False):
         """Teacher-forced full-sequence decoder → logits [B, T, V].
         Causal masking makes position t depend only on tgt_in_ids[:, :t+1],

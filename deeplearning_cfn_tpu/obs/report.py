@@ -200,6 +200,25 @@ def summarize(path: str,
                 "fair_share_violation_max":
                     last.get("serve_fair_share_violation_max"),
             }
+        # Chunked-prefill section — only when the snapshot carries the
+        # chunk surface (--prefill-chunk runs).
+        if last.get("serve_chunk_size") is not None:
+            out["serve"]["chunked_prefill"] = {
+                "chunk_size": last.get("serve_chunk_size"),
+                "chunk_ticks": last.get("serve_chunk_ticks"),
+                "chunk_tokens": last.get("serve_chunk_tokens"),
+                "chunks_per_tick": {
+                    "p50": last.get("serve_chunks_per_tick_p50"),
+                    "p95": last.get("serve_chunks_per_tick_p95"),
+                },
+                "partial_rows": last.get("serve_chunk_partial_rows"),
+                "stall_ticks_avoided":
+                    last.get("serve_chunk_stall_ticks_avoided"),
+                "ticks_per_prefill": {
+                    "p50": last.get("serve_chunk_ticks_per_prefill_p50"),
+                    "p95": last.get("serve_chunk_ticks_per_prefill_p95"),
+                },
+            }
         # Radix token-prefix KV cache section — only when the snapshot
         # carries the radix surface (--radix-cache runs).
         if last.get("serve_radix_nodes") is not None:
@@ -325,6 +344,16 @@ def render_report(summary: Dict[str, Any]) -> str:
                 L.append(f"  qos {cls:<15} n={_fmt(v.get('completed')):<5} "
                          f"p50 {_fmt(v.get('latency_p50_s'), 's')}  "
                          f"p95 {_fmt(v.get('latency_p95_s'), 's')}")
+        ck = s.get("chunked_prefill")
+        if ck:
+            tp = ck.get("ticks_per_prefill") or {}
+            L.append(f"  chunked prefill     chunk {_fmt(ck['chunk_size'])} "
+                     f"tok/tick  {_fmt(ck['chunk_ticks'])} ticks / "
+                     f"{_fmt(ck['chunk_tokens'])} tokens")
+            L.append(f"  chunk interleave    "
+                     f"{_fmt(ck['stall_ticks_avoided'])} stall ticks "
+                     f"avoided, ticks/prefill p50 {_fmt(tp.get('p50'))}  "
+                     f"p95 {_fmt(tp.get('p95'))}")
         rx = s.get("radix")
         if rx:
             L.append(f"  radix cache         {_fmt(rx['nodes'])} nodes / "

@@ -100,6 +100,8 @@ class TailState:
         self.preemptions: Optional[Any] = None
         self.radix_hits: Optional[Any] = None
         self.radix_hit_rate: Optional[Any] = None
+        self.chunk_ticks: Optional[Any] = None
+        self.chunk_partial: Optional[Any] = None
         self.alerts = 0
         self.last_alert: Optional[str] = None
         self.launch_outcome: Optional[str] = None
@@ -127,7 +129,10 @@ class TailState:
                               ("submitted", "serve_submitted"),
                               ("preemptions", "serve_preemptions"),
                               ("radix_hits", "serve_radix_hits"),
-                              ("radix_hit_rate", "serve_radix_hit_rate")):
+                              ("radix_hit_rate", "serve_radix_hit_rate"),
+                              ("chunk_ticks", "serve_chunk_ticks"),
+                              ("chunk_partial",
+                               "serve_chunk_partial_rows")):
                 if key in r:
                     setattr(self, attr, r[key])
             return
@@ -166,6 +171,11 @@ class TailState:
                 # configurations' status lines stay byte-identical.
                 serve += (f" radix {_f(self.radix_hits)}"
                           f"@{_f(self.radix_hit_rate)}")
+            if self.chunk_ticks is not None:
+                # Only --prefill-chunk engines emit serve_chunk_* —
+                # unchunked status lines stay byte-identical.
+                serve += (f" chunk {_f(self.chunk_ticks)}"
+                          f"~{_f(self.chunk_partial)}p")
             parts.append(serve)
         if self.launch_outcome is not None:
             parts.append(f"launch {self.launch_outcome}")
@@ -210,6 +220,8 @@ class FleetTailState:
         self._preemptions: Dict[str, int] = {}
         # Per-replica radix hit counters (--radix-cache fleets only).
         self._radix_hits: Dict[str, int] = {}
+        # Per-replica chunk tick counters (--prefill-chunk fleets only).
+        self._chunk_ticks: Dict[str, int] = {}
 
     def update(self, name: str, rec: Dict[str, Any]) -> None:
         if rec.get("event") == "scale_event":
@@ -240,6 +252,8 @@ class FleetTailState:
             self._preemptions[name] = int(rec["serve_preemptions"])
         if isinstance(rec.get("serve_radix_hits"), (int, float)):
             self._radix_hits[name] = int(rec["serve_radix_hits"])
+        if isinstance(rec.get("serve_chunk_ticks"), (int, float)):
+            self._chunk_ticks[name] = int(rec["serve_chunk_ticks"])
         self.bus.observe(name, rec)
 
     def scale_state(self) -> str:
@@ -271,6 +285,8 @@ class FleetTailState:
             parts.insert(3, f"preempt {sum(self._preemptions.values())}")
         if self._radix_hits:
             parts.insert(3, f"radix {sum(self._radix_hits.values())}")
+        if self._chunk_ticks:
+            parts.insert(3, f"chunk {sum(self._chunk_ticks.values())}")
         fails = {n: s.launch_outcome
                  for n, s in self.bus.replicas.items()
                  if s.launch_outcome not in (None, "ok")}

@@ -140,6 +140,13 @@ class _Group:
     # from the tree rather than freshly prefilled.
     radix_hit_tokens: int = 0
     radix_shared_blocks: int = 0
+    # Chunked prefill (prefill_chunk > 0): how many source tokens the
+    # per-tick chunk quota has covered so far, and how many chunk ticks
+    # this group has participated in. A group lives in ``_prefilling``
+    # until the cursor covers its source; only then does it run the
+    # full-width completion prefill, join ``_groups``, and decode.
+    prefill_cursor: int = 0
+    chunk_ticks: int = 0
 
 
 class Engine:
@@ -177,7 +184,8 @@ class Engine:
                  metrics: Optional[ServeMetrics] = None,
                  retry_after_floor_s: Optional[float]
                  = RequestQueue.DEFAULT_RETRY_AFTER_FLOOR_S,
-                 qos_classes: Optional[Dict[str, QosSpec]] = None):
+                 qos_classes: Optional[Dict[str, QosSpec]] = None,
+                 prefill_chunk: int = 0):
         if capacity <= 0:
             raise ValueError(f"capacity must be positive, got {capacity}")
         if decode_window <= 0:
@@ -206,6 +214,22 @@ class Engine:
                 "disaggregated phases require the paged KV path "
                 "(kv_block_size > 0) — the handoff artifact is "
                 "block-structured")
+        # Chunked prefill (Sarathi-style stall-free batching): admission
+        # encode proceeds `prefill_chunk` source tokens per tick instead
+        # of one monolithic [capacity, S] encode before the decode
+        # window, so co-resident decode streams never stall behind a
+        # long prompt. Co-located engines only: disaggregated phases
+        # already keep prefill off the decode tick by splitting the
+        # fleet.
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {prefill_chunk}")
+        if self.prefill_chunk > 0 and self.phase != "both":
+            raise ValueError(
+                "chunked prefill is a co-located-engine feature — "
+                "disaggregated phases already split prefill off the "
+                "decode tick")
         # Int8 weight-only quantization happens HERE, not in the loader:
         # the engine owns the (model clone, quantized params) pairing, so
         # swap_variables can re-quantize an incoming fp32 checkpoint and
@@ -262,6 +286,11 @@ class Engine:
         # The phase ledger + goodput accounting is always on for engine
         # requests (bare ServeMetrics instances keep the base surface).
         self.metrics.configure_request_ledger()
+        if self.prefill_chunk > 0:
+            self.metrics.configure_chunked_prefill(self.prefill_chunk)
+            # The overload hint stretches by the queued-prompt-token
+            # backlog over this quota (see RequestQueue._base_hint).
+            self.queue.configure_prefill_chunk(self.prefill_chunk)
         # The QoS surface (preemptions, per-class latency) appears only
         # once multi-tenancy is actually in play — at construction for an
         # explicit policy, lazily at the first tenant-tagged submit
@@ -374,6 +403,15 @@ class Engine:
         self._encode_fn = jax.jit(
             lambda v, src, mask: model.apply(v, src, mask,
                                              method=mcls.encode))
+        if self.prefill_chunk > 0:
+            # Chunk ticks encode prefix-truncated sources at the SAME
+            # [capacity, max_src_len] shape admission uses, so chunking
+            # adds exactly one compiled encoder variant, ever.
+            self._chunk_encode_fn = jax.jit(
+                lambda v, src, mask: model.apply(
+                    v, src, mask, method=mcls.encode_partial))
+        else:
+            self._chunk_encode_fn = None
 
         nb, bs = self.kv_blocks, self.kv_block_size
 
@@ -469,6 +507,11 @@ class Engine:
         self._pos = np.zeros((cap,), np.int32)
         self._row_owner: List[Optional[str]] = [None] * cap
         self._groups: List[_Group] = []
+        # Chunked prefill: groups admitted (rows owned, worst-case block
+        # commit held) whose source encode is still chunk-in-progress —
+        # excluded from every decode path until their cursor covers the
+        # source and the full-width completion prefill runs.
+        self._prefilling: List[_Group] = []
         # Prefill phase: groups whose prefill step ran, parked with their
         # rows and blocks still bound, awaiting export_handoff +
         # release_handoff (or cancel/expiry via _reap_parked). Subsequent
@@ -576,10 +619,13 @@ class Engine:
         reason — its entries are old-weight encoder outputs. Compiled
         functions are keyed on shapes only, so the swap costs no
         recompilation."""
-        if self._groups or self.queue.depth > 0 or self._handoff_ready:
+        if self._groups or self._prefilling or self.queue.depth > 0 \
+                or self._handoff_ready:
             raise RuntimeError(
                 f"swap_variables requires an idle engine "
-                f"({len(self._groups)} running, {self.queue.depth} queued, "
+                f"({len(self._groups)} running, "
+                f"{len(self._prefilling)} prefilling, "
+                f"{self.queue.depth} queued, "
                 f"{len(self._handoff_ready)} parked for handoff) "
                 f"— drain first")
         if self.quantize:
@@ -616,7 +662,7 @@ class Engine:
 
     @property
     def active_requests(self) -> int:
-        return len(self._groups)
+        return len(self._groups) + len(self._prefilling)
 
     @property
     def handoff_pending(self) -> int:
@@ -779,6 +825,9 @@ class Engine:
         group.req.finished_at = now
         if group in self._groups:
             self._groups.remove(group)
+        elif group in self._prefilling:
+            # Cancelled/expired mid-chunked-prefill (_reap).
+            self._prefilling.remove(group)
         else:
             # Cancelled/expired while parked for handoff (_reap_parked).
             self._handoff_ready.pop(group.req.id, None)
@@ -836,9 +885,10 @@ class Engine:
         group.req.tokens = [int(t) for t in gen[best]]
 
     def _reap(self, now: float) -> None:
-        """Evict cancelled/expired running requests — their rows are free
-        for this very step's admission ("within one step")."""
-        for g in list(self._groups):
+        """Evict cancelled/expired running (or mid-chunked-prefill)
+        requests — their rows are free for this very step's admission
+        ("within one step")."""
+        for g in list(self._groups) + list(self._prefilling):
             if g.req.cancel_requested:
                 if g.req.beam_size > 1:
                     self._finalize_beam(g)
@@ -884,7 +934,17 @@ class Engine:
         no record_finish/trace — its lifecycle continues on resume."""
         self.metrics.record_ledger(wasted=group.decoded, reason="preempted")
         self._free_group_resources(group)
-        self._groups.remove(group)
+        if group in self._groups:
+            self._groups.remove(group)
+        else:
+            # A half-prefilled victim: zero decode work sunk (decoded is
+            # 0, parked_tokens stays empty, so the zero-loss audit holds
+            # trivially) — the resumed attempt re-chunks from cursor 0
+            # in a fresh group.
+            self._prefilling.remove(group)
+            if self.prefill_chunk > 0:
+                self.queue.note_prefill_backlog(
+                    self._chunk_backlog_tokens())
         req = group.req
         if len(req.tokens) > len(req.parked_tokens):
             req.parked_tokens = list(req.tokens)
@@ -906,7 +966,7 @@ class Engine:
             return None
         head_prio = self.queue.qos_spec(head.qos_class).priority
         candidates = []
-        for g in self._groups:
+        for g in self._groups + self._prefilling:
             spec = self.queue.qos_spec(g.req.qos_class)
             if spec.preemptible and spec.priority > head_prio:
                 candidates.append((spec.priority, -g.decoded,
@@ -1061,6 +1121,20 @@ class Engine:
                 self.queue.fair_share_violation_max())
         if not admits:
             return
+        if self.prefill_chunk > 0:
+            # Chunked admission: rows and the worst-case block commit
+            # are held from this instant (admission semantics exactly as
+            # before), but the source encode is deferred to per-tick
+            # chunk quotas — queue_wait ends HERE, the same tick the
+            # first chunk runs, and prefill_s accumulates from zero
+            # across chunk ticks (_chunk_tick).
+            self._groups = [g for g in self._groups if g not in admits]
+            for group in admits:
+                group.req.state = RequestState.PREFILLING
+                group.req.prefill_s = 0.0
+                self._prefilling.append(group)
+            self.queue.note_prefill_backlog(self._chunk_backlog_tokens())
+            return
         t_prefill = self._clock()
         try:
             self._prefill(admits)
@@ -1155,6 +1229,124 @@ class Engine:
                                         jnp.asarray(src), jnp.asarray(mask))
         self._enc_d = self._admit_scatter1_fn(
             self._enc_d, enc_new, jnp.asarray(row_targets))
+
+    # -- chunked prefill ---------------------------------------------------
+
+    def _chunk_backlog_tokens(self) -> int:
+        """Source tokens still awaiting a chunk across PREFILLING rows —
+        the backlog term in the queue's retry-after hint."""
+        return sum(len(g.req.src_ids) - g.prefill_cursor
+                   for g in self._prefilling)
+
+    def _chunk_tick(self, now: float) -> int:
+        """One chunk tick: spend the per-tick token quota
+        (``prefill_chunk``) across the PREFILLING groups' source cursors
+        — QoS priority order first (a latency head's chunks outrank a
+        batch tenant's flood), admission order within a class — then run
+        ONE fixed-shape partial encode over the advanced-but-incomplete
+        rows and the full-width completion prefill over rows whose
+        cursor now covers their source. Completion reuses
+        :meth:`_prefill` verbatim (full source, prefix cache, draft
+        prefill), so a chunked admission's encoder state is bit-
+        identical to the one-shot path — the token-parity contract.
+        Returns the number of groups advanced (nonzero keeps the fleet
+        router's wedge detection seeing progress on chunk-only ticks)."""
+        if not self._prefilling:
+            return 0
+        had_decode = bool(self._groups)
+        order = sorted(
+            self._prefilling,
+            key=lambda g: (
+                self.queue.qos_spec(g.req.qos_class).priority
+                if self.queue.qos_active else 0,
+                g.req.admitted_at or 0.0))
+        quota = self.prefill_chunk
+        used = 0
+        advanced: List[_Group] = []
+        for g in order:
+            if quota <= 0:
+                break
+            take = min(quota, len(g.req.src_ids) - g.prefill_cursor)
+            if take <= 0:
+                continue
+            g.prefill_cursor += take
+            quota -= take
+            used += take
+            g.chunk_ticks += 1
+            g.req.prefill_chunks += 1
+            advanced.append(g)
+        if not advanced:
+            return 0
+        completing = [g for g in advanced
+                      if g.prefill_cursor >= len(g.req.src_ids)]
+        partial = [g for g in advanced
+                   if g.prefill_cursor < len(g.req.src_ids)]
+        t0 = self._clock()
+        if partial:
+            self._partial_encode(partial)
+        if completing:
+            for g in completing:
+                self._prefilling.remove(g)
+                g.req.state = RequestState.RUNNING
+                # Re-assert the decode-entry mirrors: fused windows run
+                # while this group sat PREFILLING overwrite the whole
+                # _prev mirror (inactive rows come back PAD from the
+                # scan — the same clobber export_handoff documents), so
+                # BOS / the radix-resume tail token must be restored
+                # before the first decode step attends this row.
+                if g.steps > 0:
+                    self._prev[g.rows[0]] = g.req.tokens[-1]
+                    self._pos[g.rows[0]] = g.steps
+                else:
+                    for r in g.rows:
+                        self._prev[r] = BOS_ID
+                        self._pos[r] = 0
+                self._groups.append(g)
+            self._prefill(completing)
+        # Every advanced group experienced this whole tick as (part of)
+        # its prefill phase — the same whole-call attribution rule the
+        # one-shot path uses, summed across chunk ticks.
+        dt = self._clock() - t0
+        for g in advanced:
+            g.req.prefill_s = (g.req.prefill_s or 0.0) + dt
+        for g in completing:
+            self.metrics.record_chunk_prefill_done(g.chunk_ticks)
+        self.metrics.record_chunk_tick(
+            chunks=len(advanced), tokens=used,
+            partial_rows=len(self._prefilling),
+            decode_active=had_decode)
+        self.queue.note_prefill_backlog(self._chunk_backlog_tokens())
+        return len(advanced)
+
+    def _partial_encode(self, groups: List[_Group]) -> None:
+        """Encode the groups' chunk-covered source prefixes at the SAME
+        [capacity, max_src_len] shape admission uses (suffix stays PAD,
+        mask truncated at the cursor), scattering provisional rows into
+        the encoder tables. Provisional is safe by construction: no
+        decode step attends a PREFILLING row (they are not in
+        ``_groups``), and the completion tick's full-width
+        :meth:`_prefill` overwrites every one of these rows — the
+        encoder is bidirectional, so only the final full-source encode
+        is authoritative. The draft encoder table is deliberately NOT
+        refreshed per chunk (completion refreshes it once)."""
+        cap, s = self.capacity, self.max_src_len
+        src = np.full((cap, s), PAD_ID, np.int32)
+        row_targets = np.full((cap,), cap, np.int32)
+        j = 0
+        for g in groups:
+            row_src = np.full((s,), PAD_ID, np.int32)
+            prefix = g.req.src_ids[:g.prefill_cursor]
+            row_src[:len(prefix)] = prefix
+            for r in g.rows:
+                src[j] = row_src
+                row_targets[j] = r
+                j += 1
+        mask = (src != PAD_ID).astype(np.int32)
+        enc_new = self._chunk_encode_fn(self.variables, jnp.asarray(src),
+                                        jnp.asarray(mask))
+        self._enc, self._src_mask = self._admit_scatter_fn(
+            self._enc, self._src_mask, enc_new, jnp.asarray(mask),
+            jnp.asarray(row_targets))
 
     def _beam_select(self, w: int):
         """Jitted per-group candidate selection — the same f32 log-softmax
@@ -1253,6 +1445,11 @@ class Engine:
         every row busy so nothing could admit until an eviction — which
         itself lands at the window boundary)."""
         if self.decode_window <= 1:
+            return 1
+        if self._prefilling:
+            # Partial-prefill rows must receive their next chunk at the
+            # very next tick — a fused window would starve the chunk
+            # quota and re-introduce exactly the stall chunking removes.
             return 1
         if self.phase == "prefill":
             # Prefill runs exactly one decode step per request before
@@ -1615,25 +1812,36 @@ class Engine:
     # -- the step ----------------------------------------------------------
 
     def step(self) -> int:
-        """One engine tick: reap → admit (batched prefill) → one decode
-        window over all rows → per-group bookkeeping → evict finished.
-        Returns the number of decode steps run (0 = fully idle). Greedy-
-        only ticks run the fused device-resident path (possibly a multi-
-        step window); any tick with a beam group falls back to the
-        single-step logits path so beam parity is untouched."""
+        """One engine tick: reap → admit (batched prefill, or row/block
+        reservation only under chunked prefill) → one chunk tick over
+        the PREFILLING rows → one decode window over all running rows →
+        per-group bookkeeping → evict finished. Returns the number of
+        decode steps run (0 = fully idle; a chunk-only tick reports 1 so
+        drivers see the progress). Greedy-only ticks run the fused
+        device-resident path (possibly a multi-step window); any tick
+        with a beam group falls back to the single-step logits path so
+        beam parity is untouched."""
         now = self._clock()
         self._reap(now)
         self._reap_parked(now)
         with span("serve.admit", queued=self.queue.depth) as sp:
-            before = len(self._groups)
+            before_g = len(self._groups)
+            before_p = len(self._prefilling)
             self._admit(now)
-            if len(self._groups) > before:
+            admitted = self._groups[before_g:] \
+                + self._prefilling[before_p:]
+            if admitted:
                 # Tag the tick with what it admitted, so the exporter can
                 # correlate engine spans with serve.request lifecycles.
-                sp.annotate(request_ids=[
-                    g.req.id for g in self._groups[before:]])
+                sp.annotate(request_ids=[g.req.id for g in admitted])
+        chunked = 0
+        if self._prefilling:
+            with span("serve.chunk_prefill",
+                      rows=len(self._prefilling)) as sp:
+                chunked = self._chunk_tick(now)
+                sp.annotate(advanced=chunked)
         if not self._groups:
-            return 0
+            return 1 if chunked else 0
         active_ids = [g.req.id for g in self._groups]
         if any(g.req.beam_size > 1 for g in self._groups):
             with span("serve.decode", path="host", k=1,
@@ -2141,7 +2349,8 @@ class Engine:
         final one on drain. Returns the number of engine ticks taken (a
         tick may run up to ``decode_window`` decode steps)."""
         steps = 0
-        while (self.queue.depth > 0 or self._groups) and steps < max_steps:
+        while (self.queue.depth > 0 or self._groups
+               or self._prefilling) and steps < max_steps:
             self.step()
             steps += 1
             if writer is not None and emit_every > 0 \

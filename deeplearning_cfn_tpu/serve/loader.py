@@ -71,6 +71,7 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
                 kv_quant: str = "",
                 radix_cache: bool = False,
                 phase: str = "both",
+                prefill_chunk: int = 0,
                 step: int = 0, vocab: str = "", allow_init: bool = False,
                 clock=time.monotonic) -> Tuple[Engine, object, int]:
     """Build an Engine from a trained experiment.
@@ -95,6 +96,10 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
     greedy streams' block tables are retained and shared with later
     identical-source requests (requires ``kv_block_size > 0`` and the
     co-located ``phase="both"``).
+    ``prefill_chunk > 0`` arms Sarathi-style chunked prefill: admission
+    encode proceeds that many source tokens per tick interleaved with
+    decode, so a long prompt never stalls co-resident streams (requires
+    the co-located ``phase="both"``; see docs/SERVING.md).
     """
     from ..train.run import _workdir_and_ckpt_dir
     from ..train.task import Seq2SeqTask, build_task
@@ -178,6 +183,7 @@ def load_engine(cfg: ExperimentConfig, *, capacity: int = 4,
         kv_quant=kv_quant,
         radix_cache=radix_cache,
         phase=phase,
+        prefill_chunk=prefill_chunk,
         clock=clock)
     engine.metrics.ckpt_load_retries = manager.store_retries()
     return engine, bpe, int(at_step)

@@ -116,6 +116,13 @@ class ServeMetrics:
         self._waste = None
         self._phase_prefill = None
         self._phase_decode = None
+        self._chunk_size = 0
+        self._chunk_ticks = None
+        self._chunk_tokens = None
+        self._chunks_per_tick = None
+        self._chunk_partial_rows = None
+        self._chunk_stall_avoided = None
+        self._chunk_ticks_per_prefill = None
 
     # -- optional feature surfaces -----------------------------------------
 
@@ -165,6 +172,54 @@ class ServeMetrics:
             "serve_radix_nodes", "radix tree block nodes resident")
         self._radix_blocks = r.gauge(
             "serve_radix_blocks", "pool blocks the radix tree references")
+
+    def configure_chunked_prefill(self, chunk: int) -> None:
+        """Enable the chunked-prefill surface (serve_chunk_*). Turned on
+        by the engine only when ``prefill_chunk > 0``, so unchunked
+        configurations keep their exact snapshot key set."""
+        if self._chunk_ticks is not None:
+            return
+        r = self.registry
+        self._chunk_size = int(chunk)
+        self._chunk_ticks = r.counter(
+            "serve_chunk_ticks_total", "ticks that advanced prefill chunks")
+        self._chunk_tokens = r.counter(
+            "serve_chunk_tokens_total", "source tokens encoded via chunks")
+        self._chunks_per_tick = r.histogram(
+            "serve_chunks_per_tick", "partial-prefill rows advanced per "
+            "chunk tick")
+        self._chunk_partial_rows = r.gauge(
+            "serve_chunk_partial_rows", "rows mid-prefill after the tick")
+        self._chunk_stall_avoided = r.counter(
+            "serve_chunk_stall_ticks_avoided_total",
+            "chunk ticks that shared the tick with live decode rows — "
+            "each one a full-prompt encode stall the unchunked admission "
+            "path would have imposed on them")
+        self._chunk_ticks_per_prefill = r.histogram(
+            "serve_chunk_ticks_per_prefill",
+            "chunk ticks one request's source encode spanned")
+
+    def record_chunk_tick(self, chunks: int, tokens: int,
+                          partial_rows: int, decode_active: bool) -> None:
+        """One chunk tick: ``chunks`` rows advanced by ``tokens`` source
+        tokens total, ``partial_rows`` still mid-prefill afterwards;
+        ``decode_active`` means decode rows shared this tick (the
+        stall-avoided signal)."""
+        if self._chunk_ticks is None:
+            return
+        self._chunk_ticks.inc()
+        if tokens:
+            self._chunk_tokens.inc(tokens)
+        self._chunks_per_tick.observe(float(chunks))
+        self._chunk_partial_rows.set(int(partial_rows))
+        if decode_active:
+            self._chunk_stall_avoided.inc()
+
+    def record_chunk_prefill_done(self, ticks: int) -> None:
+        """One request's source encode completed after ``ticks`` chunk
+        ticks."""
+        if self._chunk_ticks_per_prefill is not None:
+            self._chunk_ticks_per_prefill.observe(float(ticks))
 
     def record_radix_lookup(self, result: str, matched_tokens: int) -> None:
         """One admission walk: ``result`` is ``hit`` (resume from cached
@@ -897,6 +952,23 @@ class ServeMetrics:
                 self._phase_decode.percentile(50)
             snap["serve_phase_decode_p95_s"] = \
                 self._phase_decode.percentile(95)
+        if self._chunk_ticks is not None:
+            snap["serve_chunk_size"] = self._chunk_size
+            snap["serve_chunk_ticks"] = int(self._chunk_ticks.value())
+            snap["serve_chunk_tokens"] = int(self._chunk_tokens.value())
+            snap["serve_chunks_per_tick_p50"] = \
+                self._chunks_per_tick.percentile(50)
+            snap["serve_chunks_per_tick_p95"] = \
+                self._chunks_per_tick.percentile(95)
+            rows = self._chunk_partial_rows.value()
+            snap["serve_chunk_partial_rows"] = \
+                int(rows) if rows is not None else 0
+            snap["serve_chunk_stall_ticks_avoided"] = \
+                int(self._chunk_stall_avoided.value())
+            snap["serve_chunk_ticks_per_prefill_p50"] = \
+                self._chunk_ticks_per_prefill.percentile(50)
+            snap["serve_chunk_ticks_per_prefill_p95"] = \
+                self._chunk_ticks_per_prefill.percentile(95)
         return snap
 
     def emit(self, writer: MetricsWriter, **extra) -> None:

@@ -67,6 +67,11 @@ class RequestState(enum.Enum):
     # NOT a terminal state — the stream resumes (as a new attempt) on the
     # decode side, so ``finished`` stays False.
     PREFILLED = "prefilled"
+    # Chunked prefill: admitted (rows + worst-case KV commit held) but
+    # the source encode is still proceeding chunk-by-chunk; flips to
+    # RUNNING once the cursor covers the source and decode begins. Like
+    # PREFILLED, non-terminal.
+    PREFILLING = "prefilling"
 
 
 class OverloadError(RuntimeError):
@@ -187,7 +192,11 @@ class Request:
     trace_id: Optional[str] = None
     # Admission-prefill device time attributed to this request (set by
     # the engine's batched prefill; feeds the per-request phase ledger).
+    # Under chunked prefill it accumulates across chunk ticks.
     prefill_s: Optional[float] = None
+    # Chunked prefill: how many chunk ticks this request's source encode
+    # took (0 = admitted through the one-shot prefill path).
+    prefill_chunks: int = 0
     # Multi-tenant QoS identity. ``qos_class`` selects the sub-queue /
     # fair-share weight; ``tenant`` scopes rate limits and observability.
     tenant: Optional[str] = None
@@ -323,6 +332,14 @@ class RequestQueue:
         # class, accumulated only while ≥2 classes were contending.
         self._fair_expected: Dict[str, float] = {}
         self._fair_actual: Dict[str, float] = {}
+        # Chunked prefill (engine-configured): the per-tick chunk token
+        # quota and the engine's last-reported in-flight partial-prefill
+        # backlog, in tokens. Both feed the overload retry-after hint —
+        # under a prompt flood the honest wait includes draining the
+        # prefill pipeline at ``chunk`` tokens per tick, not just the
+        # decode queue-wait p50.
+        self._prefill_chunk = 0
+        self._prefill_backlog = 0
 
     @property
     def depth(self) -> int:
@@ -342,7 +359,11 @@ class RequestQueue:
     def _base_hint(self) -> Optional[float]:
         """The class-agnostic retry-after estimate (p50 of recent waits,
         then p50 of decode windows, then the floor) — exactly the pre-QoS
-        hint, so default-class rejections are unchanged."""
+        hint, so default-class rejections are unchanged. With chunked
+        prefill configured, the hint additionally covers the prompt-token
+        backlog: queued + in-flight partial-prefill source tokens drain
+        at ``_prefill_chunk`` tokens per tick, so a prompt flood yields
+        honestly longer hints than a decode-bound queue of equal depth."""
         hint = percentile(list(self._recent_waits), 50)
         if hint is None:
             hint = percentile(list(self._recent_decode_windows), 50)
@@ -350,6 +371,17 @@ class RequestQueue:
             hint = self.retry_after_floor_s
         elif self.retry_after_floor_s is not None:
             hint = max(hint, self.retry_after_floor_s)
+        if self._prefill_chunk > 0:
+            queued_tokens = self._prefill_backlog + sum(
+                len(r.src_ids)
+                for st in self._classes.values() for r in st.pending)
+            if queued_tokens > 0:
+                ticks = math.ceil(queued_tokens / self._prefill_chunk)
+                tick_s = percentile(
+                    list(self._recent_decode_windows), 50)
+                if tick_s is None:
+                    tick_s = self.retry_after_floor_s or 0.0
+                hint = (hint or 0.0) + ticks * tick_s
         return hint
 
     def _class_hint(self, st: _ClassState) -> Optional[float]:
@@ -573,6 +605,21 @@ class RequestQueue:
                 if head is not None:
                     return head
             return None
+
+    def configure_prefill_chunk(self, chunk: int) -> None:
+        """Arm the chunk-backlog term of the retry-after hint (engine-
+        called at construction when ``prefill_chunk > 0``)."""
+        if chunk < 0:
+            raise ValueError(f"chunk must be non-negative, got {chunk}")
+        with self._lock:
+            self._prefill_chunk = int(chunk)
+
+    def note_prefill_backlog(self, tokens: int) -> None:
+        """Engine-reported in-flight partial-prefill backlog: source
+        tokens admitted to rows but not yet encoded. Folded into the
+        overload hint alongside the queued prompt tokens."""
+        with self._lock:
+            self._prefill_backlog = max(0, int(tokens))
 
     def note_decode_window(self, seconds: float) -> None:
         """Record one decode-window device latency (engine-reported).

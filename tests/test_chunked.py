@@ -1,0 +1,331 @@
+"""Chunked prefill (engine --prefill-chunk): the stall-free admission
+path must be a pure scheduling change.
+
+The load-bearing guarantee is parity-by-construction: a chunk-completion
+tick re-runs the full-width prefill (the partial encodes are
+provisional), so chunked output is token-identical to the one-shot
+engine for every chunk size, window, cache layout, and search mode. On
+top of that ride the scheduling contracts: QoS priority orders the
+chunk quota, preemption mid-prefill loses zero tokens, the router's
+phase ledger stays honest (queue_wait ends at the first chunk,
+prefill_s sums the chunk ticks), and the overload hint covers the
+prompt-token backlog.
+"""
+
+import math
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning_cfn_tpu.data.bpe import NMT_SPECIALS, train_bpe
+from deeplearning_cfn_tpu.models import decoding
+from deeplearning_cfn_tpu.models.transformer_nmt import transformer_nmt_tiny
+from deeplearning_cfn_tpu.serve import (
+    Engine,
+    OverloadError,
+    RequestQueue,
+    RequestState,
+    ServeMetrics,
+)
+
+DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+
+SRC_LEN = 16
+NEW_TOKENS = 8
+
+
+def _sliver_lines(lang):
+    with open(os.path.join(DATA_DIR, f"wmt_sliver.{lang}")) as fh:
+        return [ln.strip() for ln in fh if ln.strip()]
+
+
+@pytest.fixture(scope="module")
+def chunk_setup():
+    bpe = train_bpe(_sliver_lines("de") + _sliver_lines("en"),
+                    vocab_size=300, specials=NMT_SPECIALS)
+    model = transformer_nmt_tiny(vocab_size=bpe.vocab_size, hidden_size=32,
+                                 num_layers=1, num_heads=2, mlp_dim=64,
+                                 max_len=32)
+    variables = model.init(
+        jax.random.PRNGKey(1), np.zeros((1, SRC_LEN), np.int32),
+        np.ones((1, SRC_LEN), np.int32),
+        np.zeros((1, SRC_LEN), np.int32), train=False)
+    variables = {"params": variables["params"]}
+    srcs = []
+    for line in _sliver_lines("de")[:5]:
+        ids = bpe.encode(line)[:SRC_LEN - 1]
+        srcs.append(ids + [decoding.EOS_ID])
+    return model, variables, srcs
+
+
+@pytest.fixture(scope="module")
+def unchunked_refs(chunk_setup):
+    """One unchunked engine drain per search mode × cache layout — the
+    shared reference the whole parity grid compares against (an offline
+    decode per grid cell would blow the tier-1 budget)."""
+    model, variables, srcs = chunk_setup
+    refs = {}
+    for beam in (1, 2):
+        for kv in (0, 4):
+            eng = Engine(model, variables, capacity=4, max_src_len=SRC_LEN,
+                         default_max_new_tokens=NEW_TOKENS,
+                         kv_block_size=kv)
+            reqs = [eng.submit(s, beam_size=beam) for s in srcs]
+            eng.run_until_drained()
+            refs[(beam, kv)] = [list(eng.poll(r.id).tokens) for r in reqs]
+    # The two cache layouts must already agree before chunking enters.
+    assert refs[(1, 0)] == refs[(1, 4)]
+    assert refs[(2, 0)] == refs[(2, 4)]
+    return refs
+
+
+# -- parity grid -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("chunk", [3, 8, 32],
+                         ids=["chunk3", "chunk8", "chunk-ge-src"])
+@pytest.mark.parametrize("window", [1, 4])
+@pytest.mark.parametrize("kv", [0, 4], ids=["dense", "paged"])
+@pytest.mark.parametrize("beam", [1, 2], ids=["greedy", "beam"])
+def test_chunked_prefill_token_parity(chunk_setup, unchunked_refs, chunk,
+                                      window, kv, beam):
+    """Every grid cell — chunk smaller than, comparable to, and >= the
+    source length; fused window on/off; dense and paged KV; greedy and
+    beam — produces tokens identical to the one-shot engine."""
+    model, variables, srcs = chunk_setup
+    eng = Engine(model, variables, capacity=4, max_src_len=SRC_LEN,
+                 default_max_new_tokens=NEW_TOKENS, decode_window=window,
+                 kv_block_size=kv, prefill_chunk=chunk)
+    reqs = [eng.submit(s, beam_size=beam) for s in srcs]
+    eng.run_until_drained()
+    got = [list(eng.poll(r.id).tokens) for r in reqs]
+    assert got == unchunked_refs[(beam, kv)]
+    for r, s in zip(reqs, srcs):
+        req = eng.poll(r.id)
+        assert req.state is RequestState.DONE
+        assert req.prefill_chunks == math.ceil(len(s) / chunk)
+        assert req.prefill_s is not None and req.prefill_s >= 0.0
+
+
+def test_chunk_cursor_progress_and_group_parking(chunk_setup):
+    """Mid-flight observability of the chunk pipeline: an admitted
+    request sits in PREFILLING (counted active, holding rows) until its
+    cursor covers the source, then joins the fused decode window."""
+    model, variables, srcs = chunk_setup
+    eng = Engine(model, variables, capacity=2, max_src_len=SRC_LEN,
+                 default_max_new_tokens=NEW_TOKENS, decode_window=1,
+                 prefill_chunk=4)
+    src = srcs[0]
+    ticks = math.ceil(len(src) / 4)
+    r = eng.submit(src)
+    eng.step()
+    assert eng.poll(r.id).state is RequestState.PREFILLING
+    assert eng.active_requests == 1 and eng.active_rows == 1
+    for _ in range(ticks - 1):
+        assert eng.poll(r.id).state is RequestState.PREFILLING
+        eng.step()
+    assert eng.poll(r.id).state is RequestState.RUNNING
+    eng.run_until_drained()
+    assert eng.poll(r.id).state is RequestState.DONE
+    assert eng.poll(r.id).prefill_chunks == ticks
+
+
+# -- QoS interaction ---------------------------------------------------------
+
+
+def test_latency_chunks_outrank_batch_flood(chunk_setup):
+    """The chunk quota is a fair-share dimension: a latency-class head
+    drains its source ahead of an earlier-admitted batch prompt, so the
+    interactive stream reaches decode while the flood is still
+    encoding."""
+    model, variables, srcs = chunk_setup
+    eng = Engine(model, variables, capacity=2, max_src_len=SRC_LEN,
+                 default_max_new_tokens=NEW_TOKENS, decode_window=1,
+                 prefill_chunk=8)
+    batch = eng.submit(srcs[0], tenant="tenant-b", qos_class="batch")
+    lat = eng.submit(srcs[1], tenant="tenant-a", qos_class="latency")
+    ticks = math.ceil(len(srcs[1]) / 8)
+    for _ in range(ticks):
+        eng.step()
+    # The latency stream got the whole quota first despite FIFO
+    # admission order; the batch prompt has not finished encoding.
+    assert eng.poll(lat.id).state is RequestState.RUNNING
+    assert eng.poll(batch.id).state is RequestState.PREFILLING
+    eng.run_until_drained()
+    assert eng.poll(batch.id).state is RequestState.DONE
+    assert eng.poll(lat.id).state is RequestState.DONE
+
+
+def test_preempt_mid_prefill_resumes_with_zero_token_loss(chunk_setup,
+                                                          unchunked_refs):
+    """A half-prefilled batch victim has decoded nothing — eviction
+    reclaims its rows and KV commit, the audit trivially balances, and
+    the replayed attempt re-chunks from scratch to identical tokens."""
+    model, variables, srcs = chunk_setup
+    eng = Engine(model, variables, capacity=1, max_src_len=SRC_LEN,
+                 default_max_new_tokens=NEW_TOKENS, decode_window=1,
+                 prefill_chunk=4)
+    batch = eng.submit(srcs[0], tenant="tenant-b", qos_class="batch")
+    eng.step()   # admits + first chunk: batch is mid-prefill on row 0
+    assert eng.poll(batch.id).state is RequestState.PREFILLING
+    lat = eng.submit(srcs[1], max_new_tokens=2, tenant="tenant-a",
+                     qos_class="latency")
+    eng.run_until_drained()
+    assert eng.metrics.preemptions >= 1
+    assert eng.metrics.qos_token_loss == 0
+    # Nothing was decoded before the eviction, so no replay either.
+    assert eng.metrics.preempted_tokens_replayed == 0
+    assert eng.poll(lat.id).state is RequestState.DONE
+    req = eng.poll(batch.id)
+    assert req.state is RequestState.DONE
+    assert list(req.tokens) == unchunked_refs[(1, 0)][0]
+    # Chunk ticks accumulate across both attempts: one before the
+    # eviction plus the full re-encode afterwards.
+    assert req.prefill_chunks > math.ceil(len(srcs[0]) / 4)
+
+
+# -- router phase ledger -----------------------------------------------------
+
+
+def test_router_ledger_accounts_chunked_phases(chunk_setup):
+    """The fleet ledger stays honest under chunking: the phase split
+    gains the chunk-tick count, prefill_s covers the accumulated chunk
+    time, and queue_wait + prefill + stall + decode still reconstructs
+    the e2e latency exactly."""
+    from deeplearning_cfn_tpu.fleet import EngineReplica, Router
+
+    model, variables, srcs = chunk_setup
+    eng = Engine(model, variables, capacity=2, max_src_len=SRC_LEN,
+                 default_max_new_tokens=NEW_TOKENS, decode_window=1,
+                 prefill_chunk=4)
+    router = Router([EngineReplica("replica-0", eng)],
+                    policy="round_robin")
+    rid = router.submit(srcs[0], max_new_tokens=NEW_TOKENS)
+    router.run_until_drained()
+    assert router.result(rid)["state"] == "done"
+    entry = router.ledger[rid]
+    phases = entry["phases"]
+    assert phases["prefill_chunks"] == math.ceil(len(srcs[0]) / 4)
+    # queue_wait ended at admission — the same tick the FIRST chunk ran
+    # — so the chunk time lives in prefill_s, not in the wait.
+    assert phases["prefill_s"] >= 0.0
+    assert phases["queue_wait_s"] >= 0.0
+    # The phases reconstruct e2e up to the router-submit → engine-submit
+    # dispatch gap (sub-ms, owned by no phase). Double-counting the
+    # chunk ticks in both queue_wait and prefill_s — the bug this test
+    # pins — would be off by the whole multi-tick encode, far past this
+    # tolerance.
+    total = (phases["queue_wait_s"] + phases["prefill_s"]
+             + phases["stall_s"] + phases["decode_s"]
+             + phases["emit_s"])
+    assert total == pytest.approx(entry["e2e_s"], abs=0.05)
+    # Token conservation: every decoded token is goodput (no waste on a
+    # clean single-attempt run).
+    assert entry["goodput_tokens"] == len(router.result(rid)["tokens"])
+    assert entry["wasted_tokens"] == 0
+
+
+def test_unchunked_ledger_has_no_chunk_phase(chunk_setup):
+    """Requests that never chunked keep the exact pre-chunking phase key
+    set — the ledger surface is conditional, not a new default."""
+    from deeplearning_cfn_tpu.fleet import EngineReplica, Router
+
+    model, variables, srcs = chunk_setup
+    eng = Engine(model, variables, capacity=2, max_src_len=SRC_LEN,
+                 default_max_new_tokens=NEW_TOKENS)
+    router = Router([EngineReplica("replica-0", eng)],
+                    policy="round_robin")
+    rid = router.submit(srcs[0], max_new_tokens=NEW_TOKENS)
+    router.run_until_drained()
+    assert "prefill_chunks" not in router.ledger[rid]["phases"]
+
+
+# -- overload hint -----------------------------------------------------------
+
+
+def test_retry_after_covers_prefill_chunk_backlog():
+    """With chunked prefill armed, a rejection's retry-after includes
+    draining the prompt-token backlog (queued + in-flight partial) at
+    the chunk quota per tick — a prompt flood yields honestly longer
+    hints than a decode-bound queue of equal depth."""
+    t = {"now": 0.0}
+    q = RequestQueue(max_depth=1, clock=lambda: t["now"])
+    q.submit([5] * 6, 4)
+    with pytest.raises(OverloadError) as ei:
+        q.submit([5, 2], 4)
+    base = ei.value.retry_after_s
+
+    q2 = RequestQueue(max_depth=1, clock=lambda: t["now"])
+    q2.configure_prefill_chunk(4)
+    q2.note_prefill_backlog(10)
+    q2.submit([5] * 6, 4)
+    with pytest.raises(OverloadError) as ei:
+        q2.submit([5, 2], 4)
+    # (10 in-flight + 6 queued) tokens / 4 per tick = 4 ticks at the
+    # cold-start floor, on top of the base hint.
+    floor = RequestQueue.DEFAULT_RETRY_AFTER_FLOOR_S
+    assert ei.value.retry_after_s == pytest.approx(base + 4 * floor)
+    with pytest.raises(ValueError):
+        q2.configure_prefill_chunk(-1)
+
+
+# -- metrics surface ---------------------------------------------------------
+
+
+def test_chunk_metrics_surface_is_conditional():
+    """serve_chunk_* keys appear only on chunk-configured engines —
+    unchunked snapshots keep the exact pre-chunking key set."""
+    m = ServeMetrics(capacity=4)
+    assert not any(k.startswith("serve_chunk") for k in m.snapshot())
+    m.configure_chunked_prefill(8)
+    m.record_chunk_tick(chunks=2, tokens=16, partial_rows=1,
+                        decode_active=True)
+    m.record_chunk_prefill_done(3)
+    snap = m.snapshot()
+    assert snap["serve_chunk_size"] == 8
+    assert snap["serve_chunk_ticks"] == 1
+    assert snap["serve_chunk_tokens"] == 16
+    assert snap["serve_chunk_partial_rows"] == 1
+    assert snap["serve_chunk_stall_ticks_avoided"] == 1
+    assert snap["serve_chunk_ticks_per_prefill_p50"] == 3
+
+
+def test_engine_snapshot_gains_chunk_keys_only_when_armed(chunk_setup):
+    model, variables, srcs = chunk_setup
+    plain = Engine(model, variables, capacity=2, max_src_len=SRC_LEN,
+                   default_max_new_tokens=NEW_TOKENS)
+    assert not any(k.startswith("serve_chunk")
+                   for k in plain.metrics.snapshot())
+    eng = Engine(model, variables, capacity=2, max_src_len=SRC_LEN,
+                 default_max_new_tokens=NEW_TOKENS, prefill_chunk=4)
+    r = eng.submit(srcs[0])
+    eng.run_until_drained()
+    assert eng.poll(r.id).state is RequestState.DONE
+    snap = eng.metrics.snapshot()
+    assert snap["serve_chunk_size"] == 4
+    assert snap["serve_chunk_ticks"] >= math.ceil(len(srcs[0]) / 4)
+    assert snap["serve_chunk_tokens"] >= len(srcs[0])
+
+
+# -- validation --------------------------------------------------------------
+
+
+def test_prefill_chunk_requires_colocated_phase(chunk_setup):
+    model, variables, _ = chunk_setup
+    for phase in ("prefill", "decode"):
+        with pytest.raises(ValueError, match="co-located"):
+            Engine(model, variables, capacity=2, max_src_len=SRC_LEN,
+                   kv_block_size=4, phase=phase, prefill_chunk=4)
+    with pytest.raises(ValueError):
+        Engine(model, variables, capacity=2, max_src_len=SRC_LEN,
+               prefill_chunk=-1)
+
+
+def test_fleet_bench_rejects_chunked_disagg():
+    from deeplearning_cfn_tpu.fleet.bench import run_fleet_bench
+
+    with pytest.raises(ValueError, match="co-located"):
+        run_fleet_bench(smoke=True, prefill_replicas=1, decode_replicas=1,
+                        prefill_chunk=4)

@@ -1,0 +1,74 @@
+"""CHUNK smoke gate — run by tools/t1.sh.
+
+Routes a prefill-heavy adversarial trace (long-prompt/short-decode
+adversaries interleaved with short-prompt latency streams, sources drawn
+from the wmt_sliver fixture) through one co-located chunked fleet and
+asserts the stall-free chunked-prefill contract end to end:
+
+- zero dropped requests (chunking defers encode work, it never sheds
+  admitted requests),
+- exact token parity vs the UNCHUNKED fleet the same invocation runs
+  (the completion tick re-runs the full-width prefill, so chunking must
+  be invisible in outputs) AND vs the cold single-engine baseline,
+- the goodput ledger still balances (``goodput + wasted == decoded``),
+- decode p95 under the adversary stays within a generous bound of the
+  no-adversary baseline the same invocation measures (the long prompts
+  must not stall co-resident decode streams),
+- chunked prefill actually engaged: the per-request chunk-tick p50
+  shows multi-tick encodes,
+- full determinism: a second run produces identical p95s.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning_cfn_tpu.fleet.bench import run_fleet_bench
+
+
+def main() -> int:
+    sliver = os.path.join("tests", "data", "wmt_sliver.de")
+    with open(sliver, "rb") as fh:
+        lines = [ln for ln in fh.read().splitlines() if ln.strip()]
+    # Byte-derived token ids in the bench vocab (>= 3 skips the
+    # pad/bos/eos reserved ids), capped to the smoke src_len.
+    trace = [[3 + (b % 93) for b in ln[:8]] for ln in lines][:6]
+    assert len(trace) >= 3, "wmt_sliver fixture too small for the gate"
+
+    # chunk=3 against src_len=8 makes every adversary prompt a 3-tick
+    # encode; decode_window=1 keeps latency streams surfacing tokens
+    # between chunks, which is the stall the gate is about.
+    runs = [run_fleet_bench(smoke=True, trace_mix="prefill-heavy",
+                            trace=trace, decode_window=1,
+                            prefill_chunk=3)
+            for _ in range(2)]
+    r = runs[0]
+    assert r["dropped_requests"] == 0, r
+    assert r["token_identical"] is True, r
+    assert r["token_identical_unchunked"] is True, r
+    assert r["goodput_sum_ok"] is True, r
+    ticks_p50 = r["chunk_ticks_per_prefill_p50"]
+    assert ticks_p50 is not None and ticks_p50 >= 2, r
+    chunked = r["chunked_decode_p95"]
+    noadv = r["decode_p95_no_adversary"]
+    assert chunked is not None and noadv is not None, r
+    # The latency streams must not be stalled by the adversary prompts.
+    # The bound is deliberately loose (CPU smoke timings are noisy at
+    # this scale) — it exists to catch order-of-magnitude decode stall,
+    # which is what an unchunked admission encode produces.
+    assert chunked <= 5.0 * noadv + 0.5, (chunked, noadv)
+    # Determinism: same trace, same chunk schedule, same tokens.
+    assert (runs[0]["chunk_ticks_per_prefill_p50"]
+            == runs[1]["chunk_ticks_per_prefill_p50"])
+    assert runs[0]["token_identical_unchunked"] \
+        and runs[1]["token_identical_unchunked"]
+    print(f"CHUNK_SMOKE=OK chunk={r['prefill_chunk']} "
+          f"ticks_per_prefill_p50={ticks_p50} "
+          f"chunked_p95={chunked:.4f} no_adversary_p95={noadv:.4f} "
+          f"unchunked_p95={r['unchunked_decode_p95']:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
