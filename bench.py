@@ -227,7 +227,10 @@ def _finalize_green(record: dict, alive: bool, probe_note: str,
                     "radix_hit_rate_round_robin",
                     "prefill_chunk", "chunked_decode_p95",
                     "unchunked_decode_p95",
-                    "chunk_ticks_per_prefill_p50"):
+                    "chunk_ticks_per_prefill_p50",
+                    "chaos_plan", "faults_injected",
+                    "degrade_transitions", "degrade_events",
+                    "deadline_wasted_tokens"):
             if key in record:
                 record[key] = None
     return record

@@ -351,6 +351,12 @@ def _cmd_bench(args) -> int:
         print("[dlcfn-tpu] --radix-cache is a fleet-scenario flag — pass "
               "it with --fleet", file=sys.stderr)
         return 2
+    if (getattr(args, "chaos_plan", None)
+            or getattr(args, "degrade", False)) \
+            and not getattr(args, "fleet", False):
+        print("[dlcfn-tpu] --chaos-plan/--degrade are fleet-scenario "
+              "flags — pass them with --fleet", file=sys.stderr)
+        return 2
     if getattr(args, "radix_cache", False) \
             and (getattr(args, "fleet_prefill", 0)
                  or getattr(args, "fleet_decode", 0)):
@@ -401,7 +407,9 @@ def _cmd_bench(args) -> int:
                                min_replicas=args.min_replicas,
                                max_replicas=args.max_replicas,
                                prefill_chunk=getattr(
-                                   args, "prefill_chunk", 0))
+                                   args, "prefill_chunk", 0),
+                               chaos_plan=args.chaos_plan,
+                               degrade=args.degrade)
         print(json.dumps(line))
         return 0
     if getattr(args, "obs_smoke", False):
@@ -1979,6 +1987,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fleet scenario: crash-inject replica-0 on its "
                          "Nth decode step (0 = off) — the chaos variant "
                          "of the zero-drop contract")
+    be.add_argument("--chaos-plan", default=None, metavar="PLAN.json",
+                    help="fleet scenario: site-addressable fault plan "
+                         "(FaultPlan JSON) consulted at replica.step/"
+                         "replica.submit/handoff.export/handoff.import/"
+                         "router.cancel — the record gains chaos_plan + "
+                         "faults_injected, same zero-drop/parity/"
+                         "balanced-ledger contract")
+    be.add_argument("--degrade", action="store_true",
+                    help="fleet scenario: brownout graceful degradation "
+                         "— SignalBus queue pressure steps the fleet "
+                         "through no-spec → window-cap → batch-shed "
+                         "(and hysteretically back); transitions land "
+                         "in degrade_events and "
+                         "<trace-dir>/degrade.jsonl")
     be.add_argument("--trace", default=None, metavar="SPEC",
                     help="fleet scenario: open-loop trace replay — "
                          "'poisson' | 'burst' | 'diurnal', optionally "

@@ -22,6 +22,10 @@ the serving half it never had.
 - :mod:`.autoscale` — closed-loop membership control: SignalBus
   pressure through hysteresis + cooldown into phase-aware scale-up
   (spawn + register) and zero-drop drain-based scale-down.
+- :mod:`.degrade` — brownout graceful degradation: the same SignalBus
+  pressure stepped through audited quality levels (disable speculation
+  → cap decode windows → shed batch-class admission) before any
+  latency-class traffic is rejected, with hysteretic recovery.
 - :mod:`.bench` — `dlcfn-tpu bench --fleet`: aggregate tokens/sec,
   per-replica utilization, and the token-parity/zero-drop contract
   record CI gates on.
@@ -34,6 +38,10 @@ from .autoscale import (  # noqa: F401
     Autoscaler,
     SupervisedSpawner,
     pool_signals,
+)
+from .degrade import (  # noqa: F401
+    DegradeController,
+    DegradePolicy,
 )
 from .replica import (  # noqa: F401
     EngineReplica,
@@ -61,6 +69,8 @@ from .rollout import (  # noqa: F401
 __all__ = [
     "AutoscalePolicy",
     "Autoscaler",
+    "DegradeController",
+    "DegradePolicy",
     "EngineReplica",
     "FleetOverloadError",
     "LeastLoadedPolicy",

@@ -185,7 +185,10 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
                     min_replicas: int = 1,
                     max_replicas: int = 0,
                     tick_s: float = 0.05,
-                    prefill_chunk: int = 0) -> Dict:
+                    prefill_chunk: int = 0,
+                    chaos_plan: Optional[str] = None,
+                    degrade: bool = False,
+                    degrade_policy=None) -> Dict:
     """Route the fixed trace across the fleet to drain; return the
     BENCH-contract record with the fleet fields. ``smoke`` shrinks the
     scenario AND runs the single-engine parity baseline (the t1.sh gate
@@ -260,7 +263,30 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
     ``trace_mix='prefill-heavy'``, a no-adversary baseline over the
     warmed chunked members (``decode_p95_no_adversary``): the
     co-located form of the contract disaggregation pinned, without a
-    split fleet."""
+    split fleet.
+
+    ``chaos_plan`` (a JSON path, or an already-parsed plan dict) arms
+    site-addressable fleet fault injection: the plan's
+    :class:`~..runtime.faults.FaultSpec` rules are consulted at
+    ``replica.step`` / ``replica.submit`` (by every
+    :class:`~.replica.EngineReplica`) and ``handoff.export`` /
+    ``handoff.import`` / ``router.cancel`` (by the router). The record
+    then carries ``chaos_plan`` and ``faults_injected`` (kind → fire
+    count) so a green run proves the plan actually bit. The chaos
+    contract is unchanged from ``chaos_kill_step``: zero drops, token
+    parity, balanced goodput ledger.
+
+    ``degrade`` attaches a :class:`~.degrade.DegradeController`
+    brownout loop to the router: SignalBus queue pressure steps the
+    fleet through no-speculation → capped decode windows → batch-class
+    shedding (and hysteretically back), every transition audited in the
+    record's ``degrade_transitions``/``degrade_events`` (and
+    ``<trace_dir>/degrade.jsonl``). All three levels are
+    token-preserving, so ``token_identical`` still holds.
+    ``degrade_policy`` substitutes a custom
+    :class:`~.degrade.DegradePolicy` (thresholds, streak lengths,
+    cooldown) for the controller's defaults — smoke-scale harnesses
+    need far more sensitive thresholds than a production fleet."""
     import jax
 
     from ..models.transformer_nmt import transformer_nmt_tiny
@@ -362,12 +388,20 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
     kv_block_size = 4 if (disagg or kv_quant or radix) else 0
 
     fault_plan = None
+    if chaos_plan is not None:
+        fault_plan = (FaultPlan.from_json(chaos_plan)
+                      if isinstance(chaos_plan, str)
+                      else FaultPlan.from_dict(chaos_plan))
     if chaos_kill_step > 0:
         # chaos_kill_step is 1-based ("kill on the Nth router step of
         # the first replica"); FaultSpec.at_calls counts from 0.
-        fault_plan = FaultPlan([FaultSpec(
+        kill = FaultSpec(
             op="step", key="prefill-0" if disagg else "replica-0",
-            kind="crash", at_calls=(chaos_kill_step - 1,))])
+            kind="crash", at_calls=(chaos_kill_step - 1,))
+        if fault_plan is None:
+            fault_plan = FaultPlan([kill])
+        else:
+            fault_plan.specs.append(kill)
 
     # Under trace replay, every engine AND the router read ONE virtual
     # clock — retry-after hints, queue waits, and latency percentiles
@@ -523,9 +557,10 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
     for rep in members:
         _radix_mark(rep)
     if vclock is not None:
-        router = Router(members, policy=policy, clock=_fleet_clock)
+        router = Router(members, policy=policy, clock=_fleet_clock,
+                        fault_plan=fault_plan)
     else:
-        router = Router(members, policy=policy)
+        router = Router(members, policy=policy, fault_plan=fault_plan)
     # Every replica that ever served traffic, in spawn order — retired
     # replicas leave the router but keep their engines (and token
     # counters) for the per-replica accounting below.
@@ -551,6 +586,43 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
             writers.append(w)
             rep_writers[rep.id] = w
             rep.trace_sink = JsonlSink(w)
+
+    degrade_ctrl = None
+    if degrade:
+        from ..obs.signals import SignalBus
+        from .degrade import DegradeController
+
+        deg_bus = SignalBus(names=[rep.id for rep in members])
+        deg_sink = None
+        if trace_dir is not None:
+            degrade_writer = MetricsWriter(
+                os.path.join(trace_dir, "degrade.jsonl"),
+                also_stdout=False, all_processes=True)
+            writers.append(degrade_writer)
+            # degrade_event records carry their own (virtual) "ts",
+            # which MetricsWriter preserves over its wall stamp.
+            deg_sink = degrade_writer.write
+        degrade_ctrl = DegradeController(router, deg_bus,
+                                         policy=degrade_policy,
+                                         clock=_fleet_clock,
+                                         event_sink=deg_sink)
+        _ctrl_tick = degrade_ctrl.tick
+
+        def _deg_tick():
+            # Router.step ticks the controller first thing; feed this
+            # tick's LIVE queue depths beforehand so brownout decisions
+            # track admission pressure, not an end-of-run snapshot.
+            now2 = _fleet_clock()
+            for rid2 in router.replica_ids():
+                deg_bus.observe(
+                    rid2,
+                    {"serve_queue_depth":
+                     router.replica(rid2).engine.queue.depth},
+                    ts=now2)
+            return _ctrl_tick()
+
+        degrade_ctrl.tick = _deg_tick
+        router.degrade = degrade_ctrl
 
     scaler = None
     report = None
@@ -655,7 +727,9 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
     goodput = router.goodput_tokens
     # Preemption waste is engine-internal (the router never abandons the
     # stream), so it lives in the engines' ledgers, not the router's.
-    wasted = router.wasted_tokens + sum(
+    deadline_wasted = sum(
+        rep.engine.metrics.deadline_wasted_tokens for rep in members_all)
+    wasted = router.wasted_tokens + deadline_wasted + sum(
         rep.engine.metrics.preempted_wasted_tokens for rep in members_all)
     # Radix-supplied tokens appear in results (so the router's goodput
     # and evacuation-waste ledgers count them) without ever being
@@ -779,6 +853,21 @@ def run_fleet_bench(replicas: int = 2, num_requests: int = 16,
         "dropped_requests": dropped,
         "evacuations": router.evacuations,
         "chaos_kill_step": chaos_kill_step,
+        # -- site-addressable chaos / brownout (None when off) --------
+        "chaos_plan": (chaos_plan if isinstance(chaos_plan, str)
+                       else "inline" if chaos_plan is not None else None),
+        "faults_injected":
+            dict(sorted(fault_plan.fired_counts.items()))
+            if fault_plan is not None else None,
+        "degrade_transitions":
+            degrade_ctrl.transitions if degrade_ctrl is not None
+            else None,
+        "degrade_events":
+            list(degrade_ctrl.events) if degrade_ctrl is not None
+            else None,
+        "deadline_wasted_tokens":
+            deadline_wasted if (fault_plan is not None or degrade)
+            else None,
         "token_identical": token_identical,
         "p50_latency_s": percentile(lat, 50),
         "p95_latency_s": percentile(lat, 95),

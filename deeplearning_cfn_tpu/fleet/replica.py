@@ -61,18 +61,25 @@ class EngineReplica:
     """One in-process serve engine wearing a replica identity.
 
     ``fault_plan`` hooks runtime/faults.py into the decode loop: before
-    every :meth:`step` the plan is consulted at site ``("step",
-    replica_id)``; a ``crash`` spec marks the replica DOWN and raises
-    :class:`ReplicaCrashed` (the deterministic stand-in for SIGKILL —
-    same observable effect on the fleet, replayable in-process), other
-    kinds raise their faults/latency exactly as the store wrapper does.
+    every :meth:`step` the plan is consulted at site ``("replica.step",
+    replica_id)`` (bare ``op="step"`` specs still match — see
+    FaultSpec.matches_site); a ``crash`` spec marks the replica DOWN and
+    raises :class:`ReplicaCrashed` (the deterministic stand-in for
+    SIGKILL — same observable effect on the fleet, replayable
+    in-process), ``crash_mid`` lets the step RUN first and then crashes
+    (torn state: this tick's tokens exist on a dead replica), ``hang``
+    raises the classified :class:`~..runtime.faults.InjectedHangError`,
+    ``latency`` injects a slow tick, and :meth:`submit` consults
+    ``("replica.submit", replica_id)`` the same way.
     """
 
-    def __init__(self, replica_id: str, engine, fault_plan=None):
+    def __init__(self, replica_id: str, engine, fault_plan=None,
+                 sleep=time.sleep):
         self.id = replica_id
         self.engine = engine
         self.state = ReplicaState.HEALTHY
         self.fault_plan = fault_plan
+        self._sleep = sleep
         self.crashed = False
         self.steps = 0
         # Disaggregated phase role, read off the engine ("both" for
@@ -119,9 +126,44 @@ class EngineReplica:
             or self.engine.active_requests > 0 \
             or getattr(self.engine, "handoff_pending", 0) > 0
 
+    def _consult(self, site: str):
+        if self.fault_plan is None:
+            return
+        from ..runtime.faults import InjectedFatalError, InjectedHangError, \
+            InjectedTransientError
+        for spec in self.fault_plan.consult(site, self.id):
+            if spec.kind == "crash":
+                self._die(spec.message, site)
+            elif spec.kind == "crash_mid":
+                # Deferred: the caller runs the operation first, then
+                # crashes — the torn-state variant. Only step() honours
+                # it; elsewhere it degrades to an immediate crash.
+                yield spec
+            elif spec.kind == "transient":
+                raise InjectedTransientError(
+                    spec.message or f"injected transient on {self.id}")
+            elif spec.kind == "fatal":
+                raise InjectedFatalError(
+                    spec.message or f"injected fatal on {self.id}")
+            elif spec.kind == "hang":
+                raise InjectedHangError(
+                    spec.message
+                    or f"injected hang on {self.id} ({site})")
+            elif spec.kind == "latency":
+                self._sleep(spec.latency_s)
+
+    def _die(self, message: str, site: str):
+        self.crashed = True
+        self.state = ReplicaState.DOWN
+        raise ReplicaCrashed(
+            message or f"replica {self.id} killed mid-decode "
+                       f"(injected, {site}, step {self.steps})")
+
     def submit(self, src_ids, **kwargs):
         if self.crashed:
             raise ReplicaCrashed(f"replica {self.id} is down")
+        for _ in self._consult("replica.submit"):
+            self._die("", "replica.submit")
         return self.engine.submit(src_ids, **kwargs)
 
     def poll(self, request_id: str):
@@ -135,28 +177,15 @@ class EngineReplica:
     def step(self) -> int:
         if self.crashed:
             raise ReplicaCrashed(f"replica {self.id} is down")
-        if self.fault_plan is not None:
-            for spec in self.fault_plan.consult("step", self.id):
-                if spec.kind == "crash":
-                    self.crashed = True
-                    self.state = ReplicaState.DOWN
-                    raise ReplicaCrashed(
-                        spec.message
-                        or f"replica {self.id} killed mid-decode "
-                           f"(injected, step {self.steps})")
-                if spec.kind == "transient":
-                    from ..runtime.faults import InjectedTransientError
-                    raise InjectedTransientError(
-                        spec.message or f"injected transient on {self.id}")
-                if spec.kind == "fatal":
-                    from ..runtime.faults import InjectedFatalError
-                    raise InjectedFatalError(
-                        spec.message or f"injected fatal on {self.id}")
-                if spec.kind == "latency":
-                    time.sleep(spec.latency_s)
+        crash_mid = list(self._consult("replica.step"))
         with self._traced():
             n = self.engine.step()
         self.steps += 1
+        if crash_mid:
+            # crash_mid: the engine stepped — this tick's tokens are
+            # real but live on a now-dead replica. The router evacuates
+            # them as wasted work and re-decodes elsewhere.
+            self._die(crash_mid[0].message, "replica.step")
         return n
 
     # -- KV handoff (disaggregated prefill/decode) ---------------------------

@@ -34,12 +34,23 @@ from __future__ import annotations
 import hashlib
 import itertools
 import time
+import zlib
 from typing import Dict, List, Optional, Tuple
 
+from ..ckpt.store import RetryPolicy
 from ..obs.trace import get_tracer, obs_enabled
-from ..serve.handoff import drop_handoff, load_handoff, save_handoff
-from ..serve.queue import OverloadError
+from ..serve.handoff import HandoffCorruptError, drop_handoff, \
+    load_handoff, save_handoff
+from ..serve.queue import DeadlineExceededError, OverloadError
 from .replica import EngineReplica, ReplicaCrashed, ReplicaState
+
+#: Backlog retry pacing: deterministic-jitter exponential backoff (the
+#: ckpt-store policy, re-scaled to fleet-tick time). Virtual-clock
+#: friendly — the router never sleeps, it just skips a backlog entry
+#: whose next-retry timestamp has not arrived.
+BACKLOG_RETRY = RetryPolicy(max_attempts=0, backoff_s=0.02,
+                            backoff_max_s=1.0, jitter=0.1,
+                            op_timeout_s=0.0)
 
 
 class FleetOverloadError(OverloadError):
@@ -200,6 +211,11 @@ class _LogicalRequest:
         self.replica_id: Optional[str] = None
         self.replica_rid: Optional[str] = None
         self.attempts = 0
+        # Absolute deadline on the ROUTER clock (submitted_ts +
+        # deadline_s). The honest-cancellation paths (_retry_backlog,
+        # _evacuate) compare against it instead of re-anchoring the
+        # relative deadline at every re-placement.
+        self.deadline_ts: Optional[float] = None
         # -- latency ledger / trace context ---------------------------
         self.submitted_ts: Optional[float] = None   # router clock
         self.lost_at: Optional[float] = None        # evacuated, unplaced
@@ -229,7 +245,9 @@ class Router:
 
     def __init__(self, replicas: List[EngineReplica],
                  policy="least_loaded", breaker_threshold: int = 3,
-                 clock=time.monotonic, handoff_store=None):
+                 clock=time.monotonic, handoff_store=None,
+                 fault_plan=None,
+                 backlog_retry: Optional[RetryPolicy] = BACKLOG_RETRY):
         if breaker_threshold < 1:
             raise ValueError(
                 f"breaker_threshold must be >= 1, got {breaker_threshold}")
@@ -247,6 +265,36 @@ class Router:
         self._auto_id = itertools.count()
         self.routed: Dict[str, int] = {r.id: 0 for r in replicas}
         self.evacuations = 0
+        # Router-level fault surface (runtime/faults.py): consulted at
+        # the fleet sites the replicas cannot see — ``handoff.export``
+        # / ``handoff.import`` (artifact corruption, loss, deferral)
+        # and ``router.cancel`` (cancellation deferral).
+        self._fault_plan = fault_plan
+        # Backlog retry pacing (None = retry every tick, the legacy
+        # hot-spin). rid → (retries so far, earliest next retry ts).
+        self._backlog_retry = backlog_retry
+        self._backlog_retry_state: Dict[str, Tuple[int, float]] = {}
+        self.backlog_retries = 0
+        # Hang-vs-crash classification: a step that raises TimeoutError
+        # (the watchdog class, or an injected hang) is counted here per
+        # replica — it still feeds the consecutive-failure breaker, but
+        # operators see hangs apart from crashes.
+        self.replica_hangs: Dict[str, int] = {}
+        # Handoff fault bookkeeping: artifacts the importer REJECTED
+        # (corrupt vs lost — the exporter stays parked, the hop
+        # retries) and hops deferred by injected export/import faults.
+        self.handoff_corrupt_rejects = 0
+        self.handoff_lost_rejects = 0
+        self.handoff_deferred = 0
+        # Deadline honesty: logical requests cancelled terminal-EXPIRED
+        # by the router itself (expired in the backlog or at
+        # evacuation) and handoff imports refused for expiry.
+        self.deadline_cancelled = 0
+        self.deadline_rejects = 0
+        # Brownout controller (fleet/degrade.py) — attached by the
+        # bench/operator; step() ticks it, _place() folds its recovery
+        # horizon into overload hints.
+        self.degrade = None
         # The fleet contract counter: logical requests lost with no
         # terminal state and no path to one. Stays 0 — the bench record
         # and the chaos tests assert it.
@@ -386,6 +434,8 @@ class Router:
             tenant=tenant, qos_class=qos_class,
             affinity_key=affinity_key))
         lr.submitted_ts = self._clock()
+        if deadline_s is not None:
+            lr.deadline_ts = lr.submitted_ts + float(deadline_s)
         self._requests[rid] = lr
         try:
             self._place(lr)
@@ -434,15 +484,32 @@ class Router:
             # historical call shape.
             qos_kwargs = {k: lr.spec[k] for k in ("tenant", "qos_class")
                           if lr.spec.get(k) is not None}
+            # Deadline honesty across re-placements: hand the replica
+            # the REMAINING budget against the original submit, not the
+            # verbatim relative deadline (which would re-anchor — a
+            # request evacuated twice would outlive its promise).
+            deadline_s = lr.spec["deadline_s"]
+            if lr.deadline_ts is not None:
+                deadline_s = max(lr.deadline_ts - self._clock(), 0.0)
             try:
                 r.submit(lr.spec["src_ids"],
                          max_new_tokens=lr.spec["max_new_tokens"],
                          beam_size=lr.spec["beam_size"],
-                         deadline_s=lr.spec["deadline_s"],
+                         deadline_s=deadline_s,
                          request_id=replica_rid,
                          trace_id=lr.rid, **qos_kwargs)
             except OverloadError as e:
                 hints[rep_id] = e.retry_after_s
+                continue
+            except TimeoutError:
+                # Injected (or real) submit hang: the replica did not
+                # take the request — try the next candidate.
+                self.replica_hangs[rep_id] = \
+                    self.replica_hangs.get(rep_id, 0) + 1
+                continue
+            except OSError:
+                # Transient submit fault (InjectedTransientError et
+                # al.): the submit never landed — next candidate.
                 continue
             except ReplicaCrashed:
                 # Found it dead at submit time — handle like a step-time
@@ -462,15 +529,25 @@ class Router:
             return
         retry_after = max((h for h in hints.values() if h is not None),
                           default=None)
+        if self.degrade is not None and self.degrade.level > 0:
+            # Brownout-honest hint: while degraded, per-replica hints
+            # only measure queue drain — add the degradation level's
+            # expected recovery horizon so clients back off long enough
+            # for the fleet to actually step back up.
+            retry_after = (retry_after or 0.0) \
+                + self.degrade.recovery_horizon_s()
         raise FleetOverloadError(depth, max_depth, retry_after,
                                  per_replica=hints)
 
     # -- stepping / failure handling ----------------------------------------
 
     def step(self) -> int:
-        """One fleet tick: retry the backlog, step every steppable
-        replica, absorb failures (crash → evacuate; consecutive errors →
+        """One fleet tick: tick the brownout controller, retry the
+        backlog, step every steppable replica, absorb failures (crash →
+        evacuate; hang → classified, counted; consecutive errors →
         breaker). Returns total decode steps run."""
+        if self.degrade is not None:
+            self.degrade.tick()
         self._retry_backlog()
         total = 0
         for rep_id in self.replica_ids():
@@ -482,6 +559,18 @@ class Router:
                 self._failures[rep_id] = 0
             except ReplicaCrashed:
                 self._mark_down(r)
+            except TimeoutError:
+                # Classified hang (injected or a real watchdog timeout):
+                # counted apart from crashes so the operator surface can
+                # tell "stuck" from "dead", but it feeds the same
+                # consecutive-failure breaker — a replica that hangs
+                # every tick is as useless as one that crashes.
+                self.replica_hangs[rep_id] = \
+                    self.replica_hangs.get(rep_id, 0) + 1
+                n = self._failures.get(rep_id, 0) + 1
+                self._failures[rep_id] = n
+                if n >= self.breaker_threshold:
+                    self._open_breaker(r)
             except Exception:
                 n = self._failures.get(rep_id, 0) + 1
                 self._failures[rep_id] = n
@@ -494,14 +583,39 @@ class Router:
         return total
 
     def _retry_backlog(self) -> None:
+        now = self._clock()
         still: List[str] = []
         for rid in self._backlog:
             lr = self._requests[rid]
+            if self._deadline_expired(lr, now):
+                # Deadline honesty: an expired backlog entry is
+                # CANCELLED terminal-expired, never re-placed — placing
+                # it would decode tokens nobody can use.
+                if self._cancel_faulted(rid):
+                    still.append(rid)   # cancel deferred; retried next tick
+                else:
+                    self._detach_terminal(lr, now, "expired")
+                    self._backlog_retry_state.pop(rid, None)
+                continue
+            st = self._backlog_retry_state.get(rid)
+            if st is not None and now < st[1]:
+                still.append(rid)       # backing off — not due yet
+                continue
             try:
                 self._place(lr)
+                self._backlog_retry_state.pop(rid, None)
             except (FleetOverloadError, NoReplicasError):
+                retries = (st[0] if st is not None else 0) + 1
+                self.backlog_retries += 1
+                delay = 0.0 if self._backlog_retry is None else \
+                    self._backlog_retry.backoff(
+                        retries - 1, salt=zlib.crc32(rid.encode("utf-8")))
+                self._backlog_retry_state[rid] = (retries, now + delay)
                 still.append(rid)
         self._backlog = still
+
+    def _deadline_expired(self, lr: _LogicalRequest, now: float) -> bool:
+        return lr.deadline_ts is not None and now >= lr.deadline_ts
 
     # -- disaggregated prefill → decode handoff -----------------------------
 
@@ -547,8 +661,43 @@ class Router:
         # fail parity tests instead of hiding behind an object share.
         store = self.handoff_store
         key = f"handoff/{lr.rid}-a{lr.attempts}"
+        corrupt = lost = False
+        if self._fault_plan is not None:
+            for spec in self._fault_plan.consult("handoff.export", lr.rid):
+                if spec.kind == "corrupt":
+                    corrupt = True
+                elif spec.kind == "drop":
+                    lost = True
+                else:
+                    # transient/hang/fatal export fault: the hop never
+                    # starts this tick — the stream stays parked on the
+                    # prefill side and retries next tick.
+                    self.handoff_deferred += 1
+                    return 0
         nbytes = save_handoff(store, key, artifact)
-        loaded = load_handoff(store, key)
+        if corrupt:
+            # Codec-level bit flip in the stored object: the npz
+            # container's member CRC makes the importer REJECT it.
+            raw = bytearray(store.get_bytes(key))
+            raw[len(raw) // 2] ^= 0xFF
+            store.put_bytes(key, bytes(raw))
+        if lost:
+            # The artifact vanishes between export and import (a torn
+            # transport, an eager GC) — loss, not corruption.
+            drop_handoff(store, key)
+        try:
+            loaded = load_handoff(store, key)
+        except HandoffCorruptError:
+            # Detect-and-reject: never import bytes that fail the codec
+            # or structural validation. The exporter still holds the
+            # parked stream — the hop re-exports a fresh artifact next
+            # tick, so corruption costs latency, never tokens.
+            self.handoff_corrupt_rejects += 1
+            drop_handoff(store, key)
+            return 0
+        except FileNotFoundError:
+            self.handoff_lost_rejects += 1
+            return 0
         candidates = [r for r in self._routable()
                       if getattr(r, "phase", "both") in ("decode", "both")]
         ordered = self.policy.order_for(
@@ -560,9 +709,25 @@ class Router:
             new_rid = f"{lr.rid}#a{lr.attempts}"
             qos_kwargs = {k: lr.spec[k] for k in ("tenant", "qos_class")
                           if lr.spec.get(k) is not None}
+            if self._fault_plan is not None and any(
+                    self._fault_plan.consult("handoff.import", rep_id)):
+                # Injected import fault on this candidate: skip it this
+                # hop (same recovery as an OverloadError — another
+                # candidate, or stay parked and retry next tick).
+                self.handoff_deferred += 1
+                continue
             try:
                 d.import_handoff(loaded, request_id=new_rid,
                                  trace_id=lr.rid, **qos_kwargs)
+            except DeadlineExceededError:
+                # The stream outlived its deadline while parked: honest
+                # refusal. Drop the artifact and leave the prefill-side
+                # copy alone — its engine's reaper expires it, which
+                # finalizes the logical request as EXPIRED with the
+                # prefill-decoded token ledgered as deadline waste.
+                drop_handoff(store, key)
+                self.deadline_rejects += 1
+                return 0
             except OverloadError:
                 continue
             except ReplicaCrashed:
@@ -645,10 +810,90 @@ class Router:
             lr.replica_rid = None
             lr.lost_at = now
             self.evacuations += 1
+            if self._deadline_expired(lr, now):
+                # Deadline honesty at evacuation: the copy we just
+                # abandoned was this request's last chance — re-placing
+                # it would burn decode on an already-broken promise.
+                if self._cancel_faulted(lr.rid):
+                    self._backlog.append(lr.rid)  # cancel deferred
+                else:
+                    self._detach_terminal(lr, now, "expired")
+                continue
             try:
                 self._place(lr)
             except (FleetOverloadError, NoReplicasError):
                 self._backlog.append(lr.rid)
+
+    # -- cancellation / deadline honesty ------------------------------------
+
+    def _cancel_faulted(self, rid: str) -> bool:
+        """Consult the ``router.cancel`` fault site; True = the
+        cancellation is deferred this tick (retried next)."""
+        if self._fault_plan is None:
+            return False
+        deferred = False
+        for spec in self._fault_plan.consult("router.cancel", rid):
+            if spec.kind != "latency":
+                deferred = True
+        return deferred
+
+    def _detach_terminal(self, lr: _LogicalRequest, now: float,
+                         state: str) -> None:
+        """Finalize an UNPLACED logical request in a terminal state the
+        fleet decided on its own (expired backlog entry, router-side
+        cancel). The result lands in the detached cache — ``finished``
+        / ``result`` / the ledger all see a terminal record, so the
+        request is resolved, not dropped."""
+        if lr.lost_at is not None:
+            lr.stall_s += max(now - lr.lost_at, 0.0)
+            lr.lost_at = None
+        lr.finalized = True
+        if state == "expired":
+            self.deadline_cancelled += 1
+        self._detached[lr.rid] = {"id": lr.rid, "state": state,
+                                  "tokens": [], "replica": None}
+        e2e = max(now - lr.submitted_ts, 0.0) \
+            if lr.submitted_ts is not None else None
+        entry = {
+            "request_id": lr.rid, "state": state,
+            "attempts": lr.attempts, "replicas": list(lr.hops),
+            "goodput_tokens": 0, "wasted_tokens": lr.wasted_tokens,
+            "e2e_s": e2e,
+            "phases": {"queue_wait_s": None, "prefill_s": None,
+                       "decode_s": None, "stall_s": lr.stall_s,
+                       "emit_s": None},
+        }
+        if lr.spec.get("tenant") is not None \
+                or lr.spec.get("qos_class") is not None:
+            entry["tenant"] = lr.spec.get("tenant")
+            entry["qos_class"] = lr.spec.get("qos_class") or "standard"
+            entry["preemptions"] = 0
+        self.ledger[lr.rid] = entry
+        self._emit_request_span(lr, entry)
+
+    def cancel(self, rid: str) -> bool:
+        """Cancel a logical request fleet-wide. A placed request is
+        cancelled on its replica (it reaches terminal CANCELLED through
+        the normal poll path); a backlogged one is finalized directly.
+        Returns True when the cancellation took effect, False when it
+        was deferred by an injected ``router.cancel`` fault or the
+        request is already finished/unknown."""
+        lr = self._requests.get(rid)
+        if lr is None or lr.finalized or rid in self._detached:
+            return False
+        if self._cancel_faulted(rid):
+            return False
+        if lr.replica_id is not None and lr.replica_rid is not None:
+            try:
+                self._replicas[lr.replica_id].cancel(lr.replica_rid)
+            except (KeyError, ReplicaCrashed):
+                pass
+            return True
+        if rid in self._backlog:
+            self._backlog.remove(rid)
+        self._backlog_retry_state.pop(rid, None)
+        self._detach_terminal(lr, self._clock(), "cancelled")
+        return True
 
     # -- rollout surface ----------------------------------------------------
 
@@ -815,8 +1060,14 @@ class Router:
         while self.pending() and steps < max_steps:
             before = self.step()
             steps += 1
-            if before == 0 and not self._backlog_can_move():
-                break   # wedged: nothing steppable and nothing placeable
+            if before == 0 and not self._backlog_can_move() \
+                    and not self._anything_stepping():
+                # Wedged: nothing steppable and nothing placeable. A
+                # zero-progress tick with live in-flight work is NOT a
+                # wedge — a hanging replica either recovers or trips
+                # the consecutive-failure breaker, and either way the
+                # work moves on a later tick.
+                break
         leftover = self.pending()
         if leftover:
             self.dropped_requests += len(leftover)
@@ -824,6 +1075,10 @@ class Router:
 
     def _backlog_can_move(self) -> bool:
         return bool(self._backlog) and bool(self._routable())
+
+    def _anything_stepping(self) -> bool:
+        return any(r.steppable and r.busy
+                   for r in self._replicas.values())
 
     def stats(self) -> Dict:
         per = {}
@@ -849,4 +1104,13 @@ class Router:
             "wasted_tokens": self.wasted_tokens,
             "handoffs": self.handoffs,
             "handoff_bytes": self.handoff_bytes_total,
+            "router_backlog_retries": self.backlog_retries,
+            "replica_hangs": sum(self.replica_hangs.values()),
+            "handoff_corrupt_rejects": self.handoff_corrupt_rejects,
+            "handoff_lost_rejects": self.handoff_lost_rejects,
+            "handoff_deferred": self.handoff_deferred,
+            "deadline_cancelled": self.deadline_cancelled,
+            "deadline_rejects": self.deadline_rejects,
+            "degrade_level":
+                self.degrade.level if self.degrade is not None else 0,
         }

@@ -422,10 +422,13 @@ def fleet_replica_dirs(root: str) -> List[Tuple[str, str]]:
     return found
 
 
-def _autoscale_events(root: str) -> List[Dict[str, Any]]:
-    """Every ``scale_event`` record in the fleet root's own top-level
-    ``*.jsonl`` shards (the bench writes them to
-    ``<root>/autoscale.jsonl``), in record-time order."""
+def _autoscale_events(root: str,
+                      event: str = "scale_event") -> List[Dict[str, Any]]:
+    """Every ``event``-typed record (``scale_event`` by default; the
+    brownout fold passes ``degrade_event``) in the fleet root's own
+    top-level ``*.jsonl`` shards (the bench writes them to
+    ``<root>/autoscale.jsonl`` / ``<root>/degrade.jsonl``), in
+    record-time order."""
     events: List[Dict[str, Any]] = []
     for f in sorted(os.listdir(root)):
         p = os.path.join(root, f)
@@ -435,8 +438,7 @@ def _autoscale_events(root: str) -> List[Dict[str, Any]]:
             recs, _ = _iter_records(p)
         except OSError:
             continue
-        events.extend(r for r in recs
-                      if r.get("event") == "scale_event")
+        events.extend(r for r in recs if r.get("event") == event)
     events.sort(key=lambda r: r["ts"]
                 if isinstance(r.get("ts"), (int, float)) else 0.0)
     return events
@@ -473,6 +475,25 @@ def fold_autoscale(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "last_action": last.get("action"),
         "last_replica": last.get("replica"),
         "last_phase": last.get("phase"),
+        "last_reason": last.get("reason"),
+    }
+
+
+def fold_degrade(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold a degrade-event stream into the brownout summary: the
+    current level (the last transition's), transition counters, and
+    the last what/why — the same fold ``obs tail --fleet`` applies
+    live."""
+    degrades = sum(1 for e in events if e.get("action") == "degrade")
+    recovers = sum(1 for e in events if e.get("action") == "recover")
+    last = events[-1] if events else {}
+    return {
+        "events": len(events),
+        "degrades": degrades,
+        "recovers": recovers,
+        "level": last.get("level", 0),
+        "level_name": last.get("level_name", "normal"),
+        "last_action": last.get("action"),
         "last_reason": last.get("reason"),
     }
 
@@ -540,6 +561,11 @@ def summarize_fleet(root: str) -> Dict[str, Any]:
     events = _autoscale_events(root)
     if events:
         out["autoscale"] = fold_autoscale(events)
+    # Brownout section under the same rule: only when transitions were
+    # actually audited.
+    degrade_events = _autoscale_events(root, event="degrade_event")
+    if degrade_events:
+        out["degrade"] = fold_degrade(degrade_events)
     return out
 
 
@@ -555,6 +581,9 @@ def fleet_status_line(summary: Dict[str, Any]) -> str:
     if a:
         line += (f" | scale {a['state']} "
                  f"+{a['scale_ups']}/-{a['scale_downs']}")
+    d = summary.get("degrade")
+    if d:
+        line += f" | brownout L{d['level']} ({d['level_name']})"
     return line
 
 
@@ -578,6 +607,12 @@ def render_fleet_report(summary: Dict[str, Any]) -> str:
                  f"+{a['scale_ups']} up / -{a['scale_downs']} down "
                  f"({a['drained_scale_downs']} drained) | last: "
                  f"{a['last_action']} {a['last_replica']}{why}")
+    d = summary.get("degrade")
+    if d:
+        dwhy = f" — {d['last_reason']}" if d.get("last_reason") else ""
+        L.append(f"  brownout: level {d['level']} ({d['level_name']}) | "
+                 f"{d['degrades']} degrade(s) / {d['recovers']} "
+                 f"recover(s) | last: {d['last_action']}{dwhy}")
     qbp = f.get("queue_depth_by_phase")
     if qbp and set(qbp) != {"both"}:
         L.append("  queue depth by phase: " + "  ".join(

@@ -222,8 +222,16 @@ class FleetTailState:
         self._radix_hits: Dict[str, int] = {}
         # Per-replica chunk tick counters (--prefill-chunk fleets only).
         self._chunk_ticks: Dict[str, int] = {}
+        # Brownout fold (--degrade fleets only): the last degrade_event
+        # carries the current level.
+        self.last_degrade: Optional[Dict[str, Any]] = None
+        self.degrade_transitions = 0
 
     def update(self, name: str, rec: Dict[str, Any]) -> None:
+        if rec.get("event") == "degrade_event":
+            self.degrade_transitions += 1
+            self.last_degrade = rec
+            return
         if rec.get("event") == "scale_event":
             self._scale_seen = True
             action = rec.get("action")
@@ -307,6 +315,14 @@ class FleetTailState:
                 f"scale {self.scale_state()} "
                 f"(last: {last.get('action')} {last.get('replica')}"
                 f"{why})")
+        if self.last_degrade is not None:
+            # Browning-out fleet: surface the live level. Fleets that
+            # never degrade see no degrade_event, so the legacy line
+            # stays byte-identical.
+            d = self.last_degrade
+            parts.append(f"brownout L{d.get('level')} "
+                         f"({d.get('level_name')}, "
+                         f"{self.degrade_transitions} transition(s))")
         return " | ".join(parts)
 
 
@@ -332,6 +348,8 @@ def _fleet_followers(root: str) -> List[tuple]:
             pairs.append((name, JsonlFollower(p)))
     pairs.append(("#autoscale",
                   JsonlFollower(os.path.join(root, "autoscale.jsonl"))))
+    pairs.append(("#degrade",
+                  JsonlFollower(os.path.join(root, "degrade.jsonl"))))
     return pairs
 
 

@@ -25,12 +25,23 @@ makes them first-class and *deterministic*:
 Exception taxonomy mirrors the retry classification in ckpt/store.py:
 :class:`InjectedTransientError` is an ``OSError`` (retriable),
 :class:`InjectedFatalError` is a ``ValueError`` (fatal, fail fast),
-:class:`StoreCrashed` models process death — nothing should retry it.
+:class:`InjectedHangError` is a ``TimeoutError`` (the hang class the
+launcher's watchdog would classify), :class:`StoreCrashed` models process
+death — nothing should retry it.
+
+Beyond the store, the same plan addresses **fleet sites**: dotted op names
+(``replica.step``, ``replica.submit``, ``handoff.export``,
+``handoff.import``, ``router.cancel``) are consulted by fleet/replica.py
+and fleet/router.py with the replica id or request id as the key. A bare
+``op`` (no dot) written against the pre-fleet vocabulary still matches the
+dotted site by its leaf name — ``op="step"`` matches ``replica.step`` —
+so existing plans keep firing unchanged.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import random
 import signal
@@ -50,6 +61,13 @@ class InjectedTransientError(OSError):
 
 class InjectedFatalError(ValueError):
     """A permanent storage fault — classified fatal, never retried."""
+
+
+class InjectedHangError(TimeoutError):
+    """A classified hang (the watchdog-exit role): the operation timed
+    out rather than failed. ``TimeoutError`` is an ``OSError``, so the
+    store retry classifier treats it as retriable; the fleet router
+    counts it distinctly (hang vs crash) before its breaker math."""
 
 
 class StoreCrashed(RuntimeError):
@@ -72,20 +90,33 @@ class FaultSpec:
 
     op: str = "*"
     key: str = ""
-    kind: str = "transient"  # transient | fatal | latency | crash
+    # transient | fatal | latency | crash  — the store-era kinds, plus the
+    # fleet kinds: hang (classified TimeoutError), crash_mid (the step
+    # RUNS, then the replica dies — torn state), corrupt (bit-flip the
+    # stored handoff artifact), drop (delete it after export).
+    kind: str = "transient"
     first_n: int = 0         # fire on the first N calls per site (0 = every)
     at_calls: Tuple[int, ...] = ()  # explicit per-site call indices instead
     probability: float = 0.0  # seeded coin (plan seed) instead of indexing
     latency_s: float = 0.0   # kind="latency": injected delay
     message: str = ""
 
+    KINDS = ("transient", "fatal", "latency", "crash",
+             "hang", "crash_mid", "corrupt", "drop")
+
     def __post_init__(self):
-        if self.kind not in ("transient", "fatal", "latency", "crash"):
+        if self.kind not in self.KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}")
 
     def matches_site(self, op: str, key: str) -> bool:
         if self.op != "*" and not op.startswith(self.op):
-            return False
+            # Back-compat across the fleet layering: a bare op written
+            # before sites grew layer prefixes ("step") still addresses
+            # the dotted site ("replica.step") by its leaf name. Store
+            # ops have no dots, so store matching is unchanged.
+            _, dot, leaf = op.partition(".")
+            if not (dot and "." not in self.op and leaf.startswith(self.op)):
+                return False
         return self.key in key
 
     def fires(self, call_index: int, rng: random.Random) -> bool:
@@ -104,8 +135,14 @@ class FaultPlan:
 
     def __init__(self, specs: List[FaultSpec], seed: int = 0):
         self.specs = list(specs)
+        self.seed = seed
         self._rng = random.Random(seed)
         self._site_counts: Dict[Tuple[int, str, str], int] = {}
+        # kind → times a spec of that kind fired, across all sites. The
+        # fleet bench reports this as ``faults_injected`` so a chaos run
+        # proves the plan actually bit (a plan that never fires passes
+        # every contract vacuously).
+        self.fired_counts: Dict[str, int] = {}
 
     def consult(self, op: str, key: str) -> List[FaultSpec]:
         """Advance the per-site counters and return the specs that fire
@@ -118,8 +155,40 @@ class FaultPlan:
             idx = self._site_counts.get(site, 0)
             self._site_counts[site] = idx + 1
             if spec.fires(idx, self._rng):
+                self.fired_counts[spec.kind] = \
+                    self.fired_counts.get(spec.kind, 0) + 1
                 fired.append(spec)
         return fired
+
+    # -- serialized plans (`bench --fleet --chaos-plan plan.json`) ----------
+
+    @classmethod
+    def from_dict(cls, obj: Dict) -> "FaultPlan":
+        """Build a plan from the committed-JSON shape::
+
+            {"seed": 0, "specs": [{"op": "replica.step", "key": "r0",
+                                   "kind": "hang", "at_calls": [4]}, ...]}
+
+        Unknown spec fields are rejected (a typo'd field silently
+        matching everything is the opposite of deterministic chaos).
+        """
+        specs = []
+        known = {f.name for f in dataclasses.fields(FaultSpec)}
+        for raw in obj.get("specs", []):
+            extra = set(raw) - known
+            if extra:
+                raise ValueError(
+                    f"unknown FaultSpec fields {sorted(extra)} in {raw!r}")
+            kwargs = dict(raw)
+            if "at_calls" in kwargs:
+                kwargs["at_calls"] = tuple(int(c) for c in kwargs["at_calls"])
+            specs.append(FaultSpec(**kwargs))
+        return cls(specs, seed=int(obj.get("seed", 0)))
+
+    @classmethod
+    def from_json(cls, path: str) -> "FaultPlan":
+        with open(path, "r", encoding="utf-8") as fh:
+            return cls.from_dict(json.load(fh))
 
     # -- canned scenarios ---------------------------------------------------
 
@@ -179,7 +248,9 @@ class FaultInjectionStore(Store):
                 raise InjectedTransientError(msg)
             elif spec.kind == "fatal":
                 raise InjectedFatalError(msg)
-            elif spec.kind == "crash":
+            elif spec.kind == "hang":
+                raise InjectedHangError(msg)
+            elif spec.kind in ("crash", "crash_mid"):
                 self.crashed = True
                 raise StoreCrashed(msg)
 

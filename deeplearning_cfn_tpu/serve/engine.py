@@ -104,8 +104,8 @@ from .blockpool import BlockAllocator, is_pool_leaf
 from .metrics import ServeMetrics
 from .prefix import PrefixCache, unpadded_key
 from .radix import RadixCache
-from .queue import (OverloadError, QosSpec, Request, RequestQueue,
-                    RequestState)
+from .queue import (DeadlineExceededError, OverloadError, QosSpec, Request,
+                    RequestQueue, RequestState)
 
 
 @dataclass
@@ -265,6 +265,12 @@ class Engine:
         self.variables = variables
         self.capacity = capacity
         self.decode_window = int(decode_window)
+        # Brownout knobs, flipped by fleet.degrade.DegradeController (or
+        # by hand). Both trade throughput for latency headroom without
+        # changing any emitted token: speculation and fused windows are
+        # exact accelerations of the plain greedy path.
+        self._degrade_no_spec = False
+        self._degrade_window_cap: Optional[int] = None
         self.model_max_len = int(getattr(model, "max_len", 0) or 0)
         if self.model_max_len <= 0:
             raise ValueError("model must expose max_len (the KV-cache size)")
@@ -862,8 +868,13 @@ class Engine:
                 self.metrics.record_preempt_resume_audit(
                     replayed=matched, lost=len(parked) - matched)
         else:
-            self.metrics.record_ledger(wasted=group.decoded,
-                                       reason="preempted")
+            # Expired and preempted waste are ledgered apart: a deadline
+            # miss is the *client's* budget burning down (brownout /
+            # chaos audits key on it), a preemption is the scheduler's
+            # own churn. Both satisfy goodput + wasted == decoded.
+            reason = ("deadline" if state is RequestState.EXPIRED
+                      else "preempted")
+            self.metrics.record_ledger(wasted=group.decoded, reason=reason)
         decode_s = None
         if group.req.admitted_at is not None:
             decode_s = max(
@@ -1474,7 +1485,12 @@ class Engine:
                     spec = self.queue.qos_spec(g.req.qos_class)
                     if spec.preemptible and spec.priority > pend:
                         return 1
-        return self.decode_window
+        k = self.decode_window
+        if self._degrade_window_cap is not None:
+            # Brownout: shorter fused windows keep per-tick latency (and
+            # admission freshness) bounded at some throughput cost.
+            k = min(k, self._degrade_window_cap)
+        return max(1, k)
 
     # -- the speculative window --------------------------------------------
 
@@ -1857,6 +1873,7 @@ class Engine:
         # state migration (the spec step and the plain window share the
         # same caches and positions).
         elif self.speculate_gamma > 0 and self.phase != "prefill" \
+                and not self._degrade_no_spec \
                 and not any(g.req.deadline is not None
                             for g in self._groups):
             if self.speculate_device:
@@ -2155,6 +2172,15 @@ class Engine:
                 retry_after_s=self.queue.retry_after_floor_s)
         now = self._clock()
         deadline = float(artifact["deadline"][0])
+        if not np.isnan(deadline) and now >= deadline:
+            # Deadline honesty across the handoff seam: a stream whose
+            # budget lapsed in transit must not consume decode capacity
+            # just to expire on the next reap. Refuse before ANY state
+            # commits — the exporter's parked copy expires through its
+            # own reap and ledgers the prefill waste there.
+            raise DeadlineExceededError(
+                f"request {request_id!r} deadline passed "
+                f"{now - deadline:.3f}s before handoff import")
         req = Request(
             id=request_id,
             src_ids=[int(t) for t in artifact["src_ids"]],

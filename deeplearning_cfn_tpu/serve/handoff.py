@@ -42,6 +42,15 @@ import numpy as np
 
 HANDOFF_VERSION = 1
 
+
+class HandoffCorruptError(ValueError):
+    """The stored artifact failed to decode or validate — a torn write,
+    a codec-level bit flip, or a truncated object. The importer must
+    REJECT it (never misdecode); the exporter still holds the parked
+    prefill state, so the router retries the hop next tick. A *missing*
+    artifact raises ``FileNotFoundError`` instead — same recovery, but
+    loss and corruption are counted apart."""
+
 # meta[] slot names, in order (see module docstring).
 META_FIELDS = ("version", "width", "steps", "budget", "kv_block_size",
                "model_max_len", "max_src_len", "enc_hid")
@@ -155,9 +164,23 @@ def save_handoff(store, key: str, artifact: Dict[str, np.ndarray]) -> int:
 
 def load_handoff(store, key: str) -> Dict[str, np.ndarray]:
     """Decode + validate an artifact previously saved with
-    :func:`save_handoff`."""
-    artifact = _decode_extension_dtypes(store.get_npz(key))
-    validate_artifact(artifact)
+    :func:`save_handoff`.
+
+    Any decode or validation failure is wrapped into
+    :class:`HandoffCorruptError` — the npz container's per-member CRC32
+    catches payload bit flips as a ``BadZipFile``, and
+    :func:`validate_artifact` catches structurally-plausible-but-wrong
+    state; both mean "reject, leave the exporter parked, retry". A
+    missing object (``FileNotFoundError``) passes through untouched so
+    loss stays distinguishable from corruption."""
+    try:
+        artifact = _decode_extension_dtypes(store.get_npz(key))
+        validate_artifact(artifact)
+    except FileNotFoundError:
+        raise
+    except Exception as e:
+        raise HandoffCorruptError(
+            f"handoff artifact {key!r} is corrupt: {e}") from e
     return artifact
 
 

@@ -391,7 +391,7 @@ class ServeMetrics:
                       reason: str = "preempted") -> None:
         """Account one released request's decoded tokens: ``goodput``
         reached the response, ``wasted`` did not (``reason`` labels why:
-        beam_discard, preempted). goodput + wasted must equal the
+        beam_discard, preempted, deadline). goodput + wasted must equal the
         tokens the engine decoded for the request — the sum contract
         ``bench --fleet`` asserts."""
         if self._goodput is None:
@@ -830,6 +830,18 @@ class ServeMetrics:
                        if dict(k).get("reason") == "preempted"))
 
     @property
+    def deadline_wasted_tokens(self) -> int:
+        """Tokens decoded for requests that then missed their deadline
+        (``wasted{reason="deadline"}``). Split out from preemption waste
+        so chaos / brownout audits can tell scheduler churn from
+        client-budget misses; both buckets stay inside the
+        goodput + wasted == decoded conservation sum."""
+        if self._waste is None:
+            return 0
+        return int(sum(v for k, v in self._waste.series().items()
+                       if dict(k).get("reason") == "deadline"))
+
+    @property
     def wasted_draft_tokens(self) -> int:
         """Rejected speculation drafts. Tracked separately from
         :attr:`wasted_tokens`: draft proposals never enter
@@ -943,6 +955,7 @@ class ServeMetrics:
         if self._goodput is not None:
             snap["serve_goodput_tokens"] = self.goodput_tokens
             snap["serve_wasted_tokens"] = self.wasted_tokens
+            snap["serve_deadline_wasted_tokens"] = self.deadline_wasted_tokens
             snap["serve_wasted_draft_tokens"] = self.wasted_draft_tokens
             snap["serve_phase_prefill_p50_s"] = \
                 self._phase_prefill.percentile(50)

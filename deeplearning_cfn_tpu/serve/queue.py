@@ -117,6 +117,14 @@ class RateLimitError(OverloadError):
         self.rate_limited = True
 
 
+class DeadlineExceededError(RuntimeError):
+    """The request's absolute deadline passed before the operation could
+    commit (e.g. a KV-handoff import after the stream outlived its
+    budget in the parked gap). Deliberately NOT an OverloadError —
+    waiting does not help; the caller must cancel the stream and account
+    its decoded tokens as deadline waste, never retry it."""
+
+
 @dataclass(frozen=True)
 class QosSpec:
     """One QoS class's scheduling contract.
@@ -340,6 +348,11 @@ class RequestQueue:
         # decode queue-wait p50.
         self._prefill_chunk = 0
         self._prefill_backlog = 0
+        # Brownout shedding (fleet/degrade.py): class names whose
+        # admissions are refused while the fleet is degraded. Shedding
+        # is an OverloadError — the standard back-off contract — so
+        # shed traffic retries through the same paths it always had.
+        self.shed_classes: set = set()
 
     @property
     def depth(self) -> int:
@@ -439,6 +452,14 @@ class RequestQueue:
                 self.qos_active = True
             st.submitted += 1
             depth = sum(len(s.pending) for s in self._classes.values())
+            if cls in self.shed_classes:
+                # Degraded mode sheds this class at the edge — a
+                # rejection with an honest hint, exactly the posture an
+                # overloaded queue already has, so every existing
+                # backoff loop handles it unchanged.
+                st.rejected += 1
+                raise OverloadError(depth, self.max_depth,
+                                    retry_after_s=self._class_hint(st))
             wait = self._take_bucket_token(st, tenant, now)
             if wait is not None:
                 st.rate_limited += 1

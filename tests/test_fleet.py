@@ -653,6 +653,10 @@ def test_cli_fleet_parsers_wire_handlers():
     assert main(["bench", "--smoke"]) == 2
     # ...and --fleet refuses to combine with other scenarios.
     assert main(["bench", "--fleet", "--serve"]) == 2
+    # Chaos flags are fleet-scenario flags, never silently ignored.
+    assert main(["bench", "--serve", "--smoke",
+                 "--chaos-plan", "plan.json"]) == 2
+    assert main(["bench", "--serve", "--smoke", "--degrade"]) == 2
 
 
 def test_cli_obs_fleet_flags(tmp_path, capsys):
@@ -1038,3 +1042,688 @@ def test_fleet_chaos_trace_merges_with_flow_links(tmp_path):
                     sorted(finishes, key=lambda e: e["id"])):
         assert s["pid"] != f["pid"]      # cross-process by construction
         assert f["ts"] >= s["ts"]
+
+
+# -- fleet fault injection (fakes) -------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_transient_submit_fault_routes_to_next_candidate():
+    """An injected transient on ``replica.submit`` never lands the
+    request there — the router falls through to the next candidate and
+    nothing is dropped."""
+    plan = FaultPlan([FaultSpec(op="replica.submit", key="replica-0",
+                                kind="transient", at_calls=(0,))])
+    reps = [_fake_replica("replica-0", fault_plan=plan),
+            _fake_replica("replica-1")]
+    router = Router(reps, policy="round_robin")
+    rid = router.submit([5, 4, 3])
+    assert _placements(router, [rid]) == ["replica-1"]
+    router.run_until_drained()
+    assert router.result(rid)["state"] == "done"
+    assert router.stats()["dropped_requests"] == 0
+    # The faulted replica is stuck, not dead: the next submit lands.
+    rid2 = router.submit([6, 5, 4])
+    assert _placements(router, [rid2]) == ["replica-0"]
+
+
+def test_hang_classified_counted_and_survived():
+    """A one-tick step hang is counted apart from crashes, does NOT trip
+    a breaker below threshold, and the replica finishes its work."""
+    plan = FaultPlan([FaultSpec(op="replica.step", key="replica-0",
+                                kind="hang", at_calls=(0,))])
+    rep = _fake_replica("replica-0", fault_plan=plan, work=2)
+    router = Router([rep], breaker_threshold=3)
+    rid = router.submit([5, 4, 3])
+    router.step()   # injected hang: no progress, classified + counted
+    assert router.stats()["replica_hangs"] == 1
+    assert rep.state is ReplicaState.HEALTHY
+    router.run_until_drained()
+    assert router.result(rid)["state"] == "done"
+    assert router.stats()["dropped_requests"] == 0
+
+
+def test_repeated_hangs_feed_the_breaker():
+    """A replica that hangs every tick is as useless as one that
+    crashes: consecutive classified hangs open the breaker and the work
+    is evacuated to the survivor."""
+    plan = FaultPlan([FaultSpec(op="replica.step", key="replica-0",
+                                kind="hang")])
+    victim = _fake_replica("replica-0", fault_plan=plan)
+    survivor = _fake_replica("replica-1")
+    router = Router([victim, survivor], policy="round_robin",
+                    breaker_threshold=2)
+    rid = router.submit([5, 4, 3])
+    assert _placements(router, [rid]) == ["replica-0"]
+    router.run_until_drained()
+    assert victim.state is ReplicaState.BROKEN
+    assert router.stats()["replica_hangs"] >= 2
+    assert router.result(rid)["state"] == "done"
+    assert _placements(router, [rid]) == ["replica-1"]
+    assert router.stats()["dropped_requests"] == 0
+
+
+def test_crash_mid_tick_wastes_partial_progress():
+    """``crash_mid`` lets the step RUN before the replica dies — the
+    tick's tokens exist on a dead replica, so they are ledgered as
+    waste and re-decoded on the survivor (torn state, zero drops)."""
+    plan = FaultPlan([FaultSpec(op="replica.step", key="replica-0",
+                                kind="crash_mid", at_calls=(0,))])
+    victim = _fake_replica("replica-0", fault_plan=plan, work=3)
+    survivor = _fake_replica("replica-1", work=3)
+    router = Router([victim, survivor], policy="round_robin")
+    rid = router.submit([5, 4, 3])
+    router.step()
+    assert victim.crashed
+    st = router.stats()
+    assert st["wasted_tokens"] >= 1    # the mid-crash tick's token
+    router.run_until_drained()
+    assert router.result(rid)["state"] == "done"
+    assert router.stats()["dropped_requests"] == 0
+
+
+def test_latency_fault_injects_slow_tick():
+    """``latency`` slows the tick through the replica's injectable
+    sleep — no exception, no waste, just a slow replica."""
+    plan = FaultPlan([FaultSpec(op="replica.step", key="replica-0",
+                                kind="latency", latency_s=0.25,
+                                at_calls=(0,))])
+    slept = []
+    rep = EngineReplica("replica-0", FakeEngine(work=2),
+                        fault_plan=plan, sleep=slept.append)
+    router = Router([rep])
+    rid = router.submit([5, 4, 3])
+    router.run_until_drained()
+    assert slept == [0.25]
+    assert router.result(rid)["state"] == "done"
+    assert router.stats()["wasted_tokens"] == 0
+
+
+def test_fault_plan_counts_what_fired():
+    """``fired_counts`` proves the plan actually bit — a chaos run whose
+    plan never fires passes every contract vacuously."""
+    plan = FaultPlan([
+        FaultSpec(op="replica.step", key="replica-0", kind="hang",
+                  at_calls=(0,)),
+        FaultSpec(op="replica.step", key="replica-0", kind="crash_mid",
+                  at_calls=(1,)),
+    ])
+    victim = _fake_replica("replica-0", fault_plan=plan, work=4)
+    survivor = _fake_replica("replica-1", work=4)
+    router = Router([victim, survivor], policy="round_robin",
+                    breaker_threshold=5)
+    rid = router.submit([5, 4, 3])
+    router.run_until_drained()
+    assert plan.fired_counts == {"hang": 1, "crash_mid": 1}
+    assert router.result(rid)["state"] == "done"
+    assert router.stats()["dropped_requests"] == 0
+
+
+# -- backlog retry backoff ---------------------------------------------------
+
+
+def _backlogged_router(clock, deadline_s=None):
+    """One crashed replica, one request stranded in the backlog."""
+    plan = FaultPlan([FaultSpec(op="step", key="replica-0", kind="crash",
+                                at_calls=(0,))])
+    router = Router([_fake_replica("replica-0", fault_plan=plan)],
+                    clock=clock)
+    rid = router.submit([5, 4, 3], deadline_s=deadline_s)
+    router.step()   # crash → nowhere to evacuate → backlog
+    assert router.result(rid)["state"] == "backlogged"
+    return router, rid
+
+
+def test_backlog_retry_backs_off_between_attempts():
+    """Backlog retries are paced by the ckpt-store RetryPolicy, not
+    hammered every tick: with a frozen clock the second attempt waits
+    out the deterministic-jitter delay."""
+    clock = _Clock()
+    router, rid = _backlogged_router(clock)
+    router.step()   # retry 1: NoReplicasError → backoff state armed
+    assert router.stats()["router_backlog_retries"] == 1
+    for _ in range(5):
+        router.step()   # frozen clock: still backing off, no attempts
+    assert router.stats()["router_backlog_retries"] == 1
+    clock.advance(10.0)  # past any jittered delay
+    router.step()
+    assert router.stats()["router_backlog_retries"] == 2
+    # Capacity returns → the next due retry places; nothing dropped.
+    router.add(_fake_replica("replica-1"))
+    clock.advance(10.0)
+    router.run_until_drained()
+    assert router.result(rid)["state"] == "done"
+    assert router.stats()["dropped_requests"] == 0
+
+
+def test_backlog_retry_pacing_is_deterministic():
+    """Same scenario, two runs: identical retry counts at every tick —
+    the jitter is salted by request id, never wall-clock."""
+
+    def trace():
+        clock = _Clock()
+        router, rid = _backlogged_router(clock)
+        seen = []
+        for _ in range(8):
+            clock.advance(0.013)
+            router.step()
+            seen.append(router.stats()["router_backlog_retries"])
+        return seen
+
+    assert trace() == trace()
+
+
+# -- deadline honesty --------------------------------------------------------
+
+
+def test_expired_backlog_entry_cancelled_not_replaced():
+    """Deadline honesty in the backlog: an entry whose deadline passes
+    while it waits is finalized terminal-EXPIRED, never re-placed —
+    resolved, not dropped."""
+    clock = _Clock()
+    router, rid = _backlogged_router(clock, deadline_s=5.0)
+    router.add(_fake_replica("replica-1"))   # capacity returns...
+    clock.advance(6.0)                        # ...but too late
+    router.step()
+    assert router.finished(rid)
+    res = router.result(rid)
+    assert res["state"] == "expired" and res["tokens"] == []
+    st = router.stats()
+    assert st["deadline_cancelled"] == 1
+    assert st["dropped_requests"] == 0
+    assert router.ledger[rid]["state"] == "expired"
+    assert router.ledger[rid]["goodput_tokens"] == 0
+
+
+def test_expired_at_evacuation_cancelled_with_waste_ledgered():
+    """A crash that strands an already-expired request must not re-place
+    it: the abandoned attempt's tokens are waste, the request is
+    terminal EXPIRED."""
+    clock = _Clock()
+    plan = FaultPlan([FaultSpec(op="step", key="replica-0", kind="crash",
+                                at_calls=(1,))])
+    router = Router([_fake_replica("replica-0", fault_plan=plan, work=3),
+                     _fake_replica("replica-1", work=3)],
+                    policy="round_robin", clock=clock)
+    rid = router.submit([5, 4, 3], deadline_s=5.0)
+    router.step()        # decodes one token on replica-0
+    clock.advance(6.0)   # the promise lapses mid-flight
+    router.step()        # crash → evacuation finds it expired
+    res = router.result(rid)
+    assert res["state"] == "expired"
+    st = router.stats()
+    assert st["deadline_cancelled"] == 1
+    assert st["wasted_tokens"] >= 1      # the abandoned attempt's token
+    assert st["dropped_requests"] == 0
+    assert router.ledger[rid]["wasted_tokens"] >= 1
+
+
+def test_router_cancel_fault_defers_then_applies():
+    """An injected ``router.cancel`` fault defers the cancellation one
+    consult — the next attempt goes through."""
+    clock = _Clock()
+    plan_cancel = FaultSpec(op="router.cancel", kind="transient",
+                            at_calls=(0,))
+    plan = FaultPlan([FaultSpec(op="step", key="replica-0", kind="crash",
+                                at_calls=(0,)), plan_cancel])
+    router = Router([_fake_replica("replica-0", fault_plan=plan)],
+                    clock=clock, fault_plan=plan)
+    rid = router.submit([5, 4, 3])
+    router.step()
+    assert router.result(rid)["state"] == "backlogged"
+    assert router.cancel(rid) is False    # deferred by the fault
+    assert router.cancel(rid) is True     # retry lands
+    assert router.result(rid)["state"] == "cancelled"
+    assert router.stats()["dropped_requests"] == 0
+
+
+# -- brownout graceful degradation (fakes) -----------------------------------
+
+
+def _degrade_rig(n=2, policy=None):
+    from deeplearning_cfn_tpu.fleet.degrade import (
+        DegradeController, DegradePolicy,
+    )
+    from deeplearning_cfn_tpu.obs.signals import SignalBus
+
+    reps = [_fake_replica(f"replica-{i}", queue_depth=64)
+            for i in range(n)]
+    router = Router(reps, policy="round_robin")
+    bus = SignalBus(names=[r.id for r in reps])
+    clock = _Clock()
+    ctrl = DegradeController(
+        router, bus,
+        policy=policy or DegradePolicy(up_stable_ticks=1,
+                                       down_stable_ticks=1,
+                                       cooldown_ticks=0),
+        clock=clock)
+    router.degrade = ctrl
+
+    def feed(depth_per_replica):
+        clock.advance(0.01)
+        for r in reps:
+            bus.observe(r.id, {"serve_queue_depth": depth_per_replica},
+                        ts=clock())
+    return router, reps, ctrl, feed
+
+
+def test_degrade_steps_up_one_level_at_a_time_and_applies_knobs():
+    """Pressure walks the fleet down the brownout ladder one audited
+    level per tick: no_spec → window_cap → shed_batch — and each
+    level's knobs land on every member engine."""
+    router, reps, ctrl, feed = _degrade_rig()
+    for expect_level, name in ((1, "no_spec"), (2, "window_cap"),
+                               (3, "shed_batch")):
+        feed(100)       # way past up_queue_depth * routable
+        ctrl.tick()
+        assert ctrl.level == expect_level
+        assert ctrl.level_name == name
+    for r in reps:
+        assert r.engine._degrade_no_spec is True
+        assert r.engine._degrade_window_cap == ctrl.policy.window_cap
+        assert r.engine.queue.shed_classes == {"batch"}
+    # Ratcheted at the top: more pressure cannot push past MAX_LEVEL.
+    feed(100)
+    ctrl.tick()
+    assert ctrl.level == 3
+    assert [e["action"] for e in ctrl.events] == ["degrade"] * 3
+    assert all(e["event"] == "degrade_event" for e in ctrl.events)
+    assert ctrl.transitions == 3
+
+
+def test_degrade_recovers_hysteretically_and_clears_knobs():
+    router, reps, ctrl, feed = _degrade_rig()
+    for _ in range(3):
+        feed(100)
+        ctrl.tick()
+    assert ctrl.level == 3
+    for _ in range(3):
+        feed(0)         # calm: walk back up one level per tick
+        ctrl.tick()
+    assert ctrl.level == 0 and ctrl.level_name == "normal"
+    for r in reps:
+        assert r.engine._degrade_no_spec is False
+        assert r.engine._degrade_window_cap is None
+        assert r.engine.queue.shed_classes == set()
+    acts = [e["action"] for e in ctrl.events]
+    assert acts == ["degrade"] * 3 + ["recover"] * 3
+    assert ctrl.transitions == 6
+
+
+def test_degrade_hysteresis_streaks_and_cooldown_block_flapping():
+    from deeplearning_cfn_tpu.fleet.degrade import DegradePolicy
+
+    router, reps, ctrl, feed = _degrade_rig(
+        policy=DegradePolicy(up_stable_ticks=2, down_stable_ticks=2,
+                             cooldown_ticks=2))
+    feed(100)
+    ctrl.tick()
+    assert ctrl.level == 0      # hot for 1 tick < up_stable_ticks
+    feed(0)
+    ctrl.tick()
+    assert ctrl.level == 0      # the streak reset — no flap
+    feed(100); ctrl.tick()
+    feed(100); ctrl.tick()
+    assert ctrl.level == 1      # two consecutive hot ticks
+    feed(100); ctrl.tick()
+    feed(100); ctrl.tick()
+    assert ctrl.level == 1      # cooldown holds the next step back
+    feed(100); ctrl.tick()
+    assert ctrl.level == 2
+
+
+def test_degrade_policy_rejects_inverted_hysteresis():
+    from deeplearning_cfn_tpu.fleet.degrade import DegradePolicy
+
+    with pytest.raises(ValueError, match="hysteresis"):
+        DegradePolicy(up_queue_depth=1.0, down_queue_depth=2.0)
+    with pytest.raises(ValueError, match="cooldown"):
+        DegradePolicy(cooldown_ticks=-1)
+
+
+def test_degraded_overload_hint_adds_recovery_horizon():
+    """While browned out, FleetOverloadError.retry_after_s folds in the
+    level's expected recovery horizon so clients back off long enough
+    for the fleet to step back up."""
+    router, reps, ctrl, feed = _degrade_rig(n=1)
+    for r in reps:
+        r.engine.queue.max_depth = 0    # every submit overflows
+    with pytest.raises(FleetOverloadError) as e0:
+        router.submit([5, 4, 3])
+    base_hint = e0.value.retry_after_s or 0.0
+    for _ in range(2):
+        feed(100)
+        ctrl.tick()
+    assert ctrl.level == 2
+    with pytest.raises(FleetOverloadError) as e1:
+        router.submit([5, 4, 3])
+    horizon = ctrl.recovery_horizon_s()
+    assert horizon == 2 * ctrl.policy.level_recovery_s > 0
+    assert (e1.value.retry_after_s or 0.0) >= base_hint + horizon
+
+
+def test_degrade_shed_only_rejects_batch_class():
+    """Level 3 sheds throughput-tier admissions; the controller itself
+    never touches latency-class traffic or anything in flight."""
+    from deeplearning_cfn_tpu.serve.queue import RequestQueue
+
+    router, reps, ctrl, feed = _degrade_rig(n=1)
+    # Swap the fake's list-queue for a real RequestQueue so shed
+    # semantics (OverloadError on shed classes) are the production ones.
+    q = RequestQueue(max_depth=8)
+    reps[0].engine.queue = q
+    for _ in range(3):
+        feed(100)
+        ctrl.tick()
+    assert ctrl.level == 3 and q.shed_classes == {"batch"}
+    with pytest.raises(OverloadError):
+        q.submit([5, 4, 3], 4, tenant="t", qos_class="batch")
+    req = q.submit([5, 4, 3], 4, tenant="t", qos_class="latency")
+    assert req.qos_class == "latency"
+
+
+# -- deadline + handoff seams (real engines) ---------------------------------
+
+
+SRC_LEN_CHAOS = 8
+MAX_NEW_CHAOS = 4
+
+
+@pytest.fixture(scope="module")
+def tiny_chaos_setup():
+    """One tiny paged NMT init for the fleet-chaos seam tests: engines
+    with injectable clocks so deadline decisions replay without
+    wall-clock."""
+    import jax
+    import numpy as np
+
+    from deeplearning_cfn_tpu.models.transformer_nmt import (
+        transformer_nmt_tiny,
+    )
+    from deeplearning_cfn_tpu.serve.bench import _fixed_trace
+    from deeplearning_cfn_tpu.serve.engine import Engine
+
+    model = transformer_nmt_tiny(vocab_size=96, max_len=64)
+    init = model.init(
+        jax.random.PRNGKey(0),
+        np.zeros((1, SRC_LEN_CHAOS), np.int32),
+        np.ones((1, SRC_LEN_CHAOS), np.int32),
+        np.zeros((1, SRC_LEN_CHAOS), np.int32), train=False)
+    variables = {"params": init["params"]}
+    trace = _fixed_trace(4, SRC_LEN_CHAOS, 96, seed=0)
+
+    def make_engine(phase, **kw):
+        kw.setdefault("kv_block_size", 4)
+        kw.setdefault("capacity", 2)
+        kw.setdefault("decode_window", 2)
+        return Engine(model, variables,
+                      max_src_len=SRC_LEN_CHAOS, queue_depth=8,
+                      default_max_new_tokens=MAX_NEW_CHAOS,
+                      phase=phase, **kw)
+
+    baseline_engine = make_engine("both")
+    ids = [baseline_engine.submit(src, max_new_tokens=MAX_NEW_CHAOS).id
+           for src in trace]
+    baseline_engine.run_until_drained()
+    baseline = [list(baseline_engine.poll(i).tokens) for i in ids]
+    return {"trace": trace, "baseline": baseline,
+            "make_engine": make_engine}
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("kind,counter", [
+    ("corrupt", "handoff_corrupt_rejects"),
+    ("drop", "handoff_lost_rejects"),
+], ids=["corrupt", "lost"])
+def test_handoff_fault_detected_rejected_and_retried(tiny_chaos_setup,
+                                                     kind, counter):
+    """An injected handoff artifact fault (bit-flip / loss in the store)
+    is DETECTED and REJECTED by the importer; the exporter stays parked
+    and the retried hop lands token-identical — corruption costs
+    latency, never tokens."""
+    s = tiny_chaos_setup
+    plan = FaultPlan([FaultSpec(op="handoff.export", kind=kind,
+                                at_calls=(0,))])
+    router = Router(
+        [EngineReplica("prefill-0", s["make_engine"]("prefill")),
+         EngineReplica("decode-0", s["make_engine"]("decode"))],
+        policy="least_loaded", fault_plan=plan)
+    rid = router.submit(s["trace"][0], max_new_tokens=MAX_NEW_CHAOS)
+    router.run_until_drained()
+    st = router.stats()
+    assert st[counter] == 1
+    assert plan.fired_counts == {kind: 1}
+    assert st["handoffs"] == 1          # the retry landed
+    assert st["dropped_requests"] == 0
+    res = router.result(rid)
+    assert res["state"] == "done"
+    assert res["tokens"] == s["baseline"][0]
+
+
+@pytest.mark.chaos
+def test_import_handoff_refuses_expired_stream_pre_commit(
+        tiny_chaos_setup):
+    """Deadline honesty across the handoff seam: a stream whose budget
+    lapsed in transit is refused BEFORE any decode-side state commits —
+    rows, blocks, and the queue stay untouched for the next import."""
+    from deeplearning_cfn_tpu.serve.queue import DeadlineExceededError
+
+    s = tiny_chaos_setup
+    clock = _Clock()
+    pre = s["make_engine"]("prefill", clock=clock)
+    dec = s["make_engine"]("decode", clock=clock)
+    req = pre.submit(s["trace"][0], max_new_tokens=MAX_NEW_CHAOS,
+                     deadline_s=5.0)
+    pre.run_until_drained()
+    assert pre.handoff_ready(req.id)
+    art = pre.export_handoff(req.id)
+    rows_free = len(dec._free_rows())
+    blocks_free = dec.allocator.free_blocks
+    clock.advance(10.0)     # the promise lapses in transit
+    with pytest.raises(DeadlineExceededError):
+        dec.import_handoff(art, request_id=req.id + "#a1")
+    assert len(dec._free_rows()) == rows_free
+    assert dec.allocator.free_blocks == blocks_free
+    assert dec.active_requests == 0 and dec.queue.depth == 0
+    # The refusal is not a black hole: a live stream still imports.
+    req2 = pre.submit(s["trace"][1], max_new_tokens=MAX_NEW_CHAOS)
+    pre.run_until_drained()
+    new = dec.import_handoff(pre.export_handoff(req2.id),
+                             request_id=req2.id + "#a1")
+    dec.run_until_drained()
+    assert list(dec.poll(new.id).tokens) == s["baseline"][1]
+
+
+@pytest.mark.chaos
+def test_deadline_expires_in_flight_after_handoff_import(
+        tiny_chaos_setup):
+    """The full seam through the router: the stream hops prefill→decode
+    inside budget, then expires mid-decode on the IMPORTING replica —
+    terminal EXPIRED, waste in the ``deadline`` bucket, zero drops."""
+    s = tiny_chaos_setup
+    clock = _Clock()
+    pre_eng = s["make_engine"]("prefill", clock=clock)
+    dec_eng = s["make_engine"]("decode", clock=clock)
+    router = Router([EngineReplica("prefill-0", pre_eng),
+                     EngineReplica("decode-0", dec_eng)],
+                    policy="least_loaded", clock=clock)
+    rid = router.submit(s["trace"][0], max_new_tokens=MAX_NEW_CHAOS,
+                        deadline_s=5.0)
+    router.step()           # prefill + park + hop (all inside budget)
+    assert router.stats()["handoffs"] == 1
+    router.step()           # the decode side emits inside budget...
+    assert len(router.poll(rid).tokens) >= 1
+    clock.advance(10.0)     # ...then the promise lapses mid-decode
+    for _ in range(10):
+        router.step()
+        if router.finished(rid):
+            break
+    res = router.result(rid)
+    assert res["state"] == "expired"
+    assert router.stats()["dropped_requests"] == 0
+    # The prefill token the decode side re-decoded plus anything it got
+    # to emit are deadline waste, ledgered on the expiring engine.
+    assert dec_eng.metrics.deadline_wasted_tokens >= 1
+    snap = dec_eng.metrics.snapshot()
+    assert snap["serve_deadline_wasted_tokens"] \
+        == dec_eng.metrics.deadline_wasted_tokens
+
+
+@pytest.mark.chaos
+def test_deadline_expires_after_preemption_resume(tiny_chaos_setup):
+    """Deadline honesty across the QoS seam: a batch stream preempted by
+    a latency arrival, resumed, then expired mid-redecode splits its
+    waste across the ``preempted`` and ``deadline`` buckets — and the
+    ledger still balances to the token."""
+    from deeplearning_cfn_tpu.serve.queue import RequestState
+
+    s = tiny_chaos_setup
+    clock = _Clock()
+    eng = s["make_engine"]("both", capacity=1, decode_window=1,
+                           clock=clock)
+    r1 = eng.submit(s["trace"][0], max_new_tokens=6, deadline_s=50.0,
+                    tenant="tenant-b", qos_class="batch")
+    for _ in range(3):
+        eng.step()          # r1 prefills and decodes a little
+    assert len(r1.tokens) >= 1
+    r3 = eng.submit(s["trace"][1], max_new_tokens=2, tenant="tenant-a",
+                    qos_class="latency")
+    for _ in range(20):
+        eng.step()          # latency arrival preempts, runs, finishes
+        if eng.poll(r3.id).state is RequestState.DONE:
+            break
+    assert eng.poll(r3.id).state is RequestState.DONE
+    assert eng.metrics.preemptions >= 1
+    preempted_waste = eng.metrics.preempted_wasted_tokens
+    assert preempted_waste >= 1
+    for _ in range(20):     # r1 resumes and re-decodes (still in budget)
+        if eng.poll(r1.id).state is RequestState.RUNNING \
+                and len(r1.tokens) >= 1:
+            break
+        eng.step()
+    assert eng.poll(r1.id).state is RequestState.RUNNING
+    clock.advance(100.0)    # the deadline passes mid-redecode
+    eng.step()
+    assert eng.poll(r1.id).state is RequestState.EXPIRED
+    assert eng.metrics.deadline_wasted_tokens >= 1
+    # Wasted buckets stay apart AND the whole ledger balances:
+    # goodput + wasted == decoded, with both reasons accounted.
+    snap = eng.metrics.snapshot()
+    assert snap["serve_goodput_tokens"] + snap["serve_wasted_tokens"] \
+        == snap["serve_tokens_generated"]
+    assert snap["serve_wasted_tokens"] \
+        >= preempted_waste + eng.metrics.deadline_wasted_tokens
+    assert eng.metrics.preempted_wasted_tokens == preempted_waste
+
+
+# -- brownout observability + bench record contract --------------------------
+
+
+_DEGRADE_EVENTS = [
+    {"event": "degrade_event", "action": "degrade", "ts": 1.0,
+     "level": 1, "level_name": "no_spec", "reason": "queue_depth 9 > 6"},
+    {"event": "degrade_event", "action": "degrade", "ts": 2.0,
+     "level": 2, "level_name": "window_cap",
+     "reason": "queue_depth 9 > 6"},
+    {"event": "degrade_event", "action": "recover", "ts": 3.0,
+     "level": 1, "level_name": "no_spec",
+     "reason": "queue_depth 0 <= 2"},
+]
+
+
+def test_summarize_fleet_folds_degrade_events(tmp_path):
+    from deeplearning_cfn_tpu.obs.report import (
+        fleet_status_line,
+        render_fleet_report,
+        summarize_fleet,
+    )
+
+    root = _fleet_root(tmp_path)
+    _write_jsonl(str(tmp_path / "degrade.jsonl"), _DEGRADE_EVENTS)
+    s = summarize_fleet(root)
+    d = s["degrade"]
+    assert d["events"] == 3
+    assert d["degrades"] == 2 and d["recovers"] == 1
+    assert d["level"] == 1 and d["level_name"] == "no_spec"
+    assert d["last_action"] == "recover"
+    assert "brownout L1 (no_spec)" in fleet_status_line(s)
+    report = render_fleet_report(s)
+    assert "brownout: level 1 (no_spec)" in report
+    assert "2 degrade(s) / 1 recover(s)" in report
+
+
+def test_summarize_fleet_without_degrade_stays_legacy(tmp_path):
+    from deeplearning_cfn_tpu.obs.report import (
+        fleet_status_line,
+        summarize_fleet,
+    )
+
+    s = summarize_fleet(_fleet_root(tmp_path))
+    assert "degrade" not in s
+    assert "brownout" not in fleet_status_line(s)
+
+
+def test_fleet_tail_surfaces_brownout(tmp_path):
+    from deeplearning_cfn_tpu.obs.tail import tail
+
+    root = _fleet_root(tmp_path)
+    _write_jsonl(str(tmp_path / "degrade.jsonl"), _DEGRADE_EVENTS)
+    out = io.StringIO()
+    assert tail(root, once=True, fleet=True, out=out) == 0
+    line = out.getvalue().strip().splitlines()[-1]
+    assert "brownout L1 (no_spec, 3 transition(s))" in line
+
+
+@pytest.mark.chaos
+def test_fleet_bench_chaos_plan_record_contract():
+    """`bench --fleet --chaos-plan`: the plan fires, the record proves
+    it, and every chaos contract holds — zero drops, token parity, a
+    balanced goodput ledger."""
+    from deeplearning_cfn_tpu.fleet.bench import run_fleet_bench
+
+    plan = {"specs": [
+        {"op": "replica.step", "key": "replica-0", "kind": "hang",
+         "at_calls": [0]},
+        {"op": "replica.step", "key": "replica-0", "kind": "crash_mid",
+         "at_calls": [1]},
+    ]}
+    rec = run_fleet_bench(smoke=True, chaos_plan=plan)
+    assert rec["chaos_plan"] == "inline"
+    assert rec["faults_injected"]["hang"] == 1
+    assert rec["faults_injected"]["crash_mid"] == 1
+    assert rec["dropped_requests"] == 0
+    assert rec["token_identical"] is True
+    assert rec["goodput_sum_ok"] is True
+    assert rec["deadline_wasted_tokens"] == 0
+    assert rec["degrade_transitions"] is None
+    assert rec["degrade_events"] is None
+    assert json.dumps(rec)
+
+
+@pytest.mark.chaos
+def test_fleet_bench_degrade_record_contract():
+    """`bench --fleet --degrade`: brownout wiring changes nothing the
+    contract pins (levels are token-preserving) and the record carries
+    the transition audit."""
+    from deeplearning_cfn_tpu.fleet.bench import run_fleet_bench
+
+    rec = run_fleet_bench(smoke=True, degrade=True)
+    assert isinstance(rec["degrade_transitions"], int)
+    assert isinstance(rec["degrade_events"], list)
+    assert rec["degrade_transitions"] == len(rec["degrade_events"])
+    assert rec["deadline_wasted_tokens"] == 0
+    assert rec["chaos_plan"] is None
+    assert rec["faults_injected"] is None
+    assert rec["dropped_requests"] == 0
+    assert rec["token_identical"] is True
+    assert rec["goodput_sum_ok"] is True

@@ -1,0 +1,110 @@
+"""CHAOS_FLEET_SMOKE gate — run by tools/t1.sh.
+
+Drives the committed chaos plans (tests/fixtures/chaos/) through tiny
+fleets over the wmt_sliver fixture and asserts the fleet chaos contract
+for EVERY injected fault class:
+
+- co-located: an injected transient submit, a classified hang, a slow
+  tick, and a mid-tick crash on replica-0 — zero dropped requests,
+  exact token parity vs the single-engine baseline, balanced goodput
+  ledger, and the record proves every fault class actually fired,
+- disaggregated: a corrupted and a lost handoff artifact — the importer
+  detects and REJECTS both, the exporter stays parked, the retried hop
+  lands, and the same zero-drop/parity/ledger contract holds,
+- brownout: a prefill-heavy adversarial trace with ``--degrade`` —
+  the controller engages (at least one audited ``degrade`` transition),
+  recovers once pressure clears, and the degradation stays
+  token-preserving,
+- full determinism: a second identical run of each scenario reproduces
+  the fault fire counts and the token outputs (no wall-clock in any
+  fault or degrade decision).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning_cfn_tpu.fleet.bench import run_fleet_bench
+
+PLAN_DIR = os.path.join("tests", "fixtures", "chaos")
+
+
+def _trace():
+    sliver = os.path.join("tests", "data", "wmt_sliver.de")
+    with open(sliver, "rb") as fh:
+        lines = [ln for ln in fh.read().splitlines() if ln.strip()]
+    # Byte-derived token ids in the bench vocab (>= 3 skips the
+    # pad/bos/eos reserved ids), capped to the smoke src_len.
+    trace = [[3 + (b % 93) for b in ln[:8]] for ln in lines][:6]
+    assert len(trace) >= 3, "wmt_sliver fixture too small for the gate"
+    return trace
+
+
+def _assert_contract(rec, tag):
+    assert rec["dropped_requests"] == 0, (tag, rec)
+    assert rec["token_identical"] is True, (tag, rec)
+    assert rec["goodput_sum_ok"] is True, (tag, rec)
+
+
+def main() -> int:
+    trace = _trace()
+
+    # -- co-located fault classes: transient / hang / latency / crash_mid
+    plan = os.path.join(PLAN_DIR, "fleet_colocated.json")
+    colo = [run_fleet_bench(smoke=True, trace=trace, chaos_plan=plan)
+            for _ in range(2)]
+    r = colo[0]
+    _assert_contract(r, "colocated")
+    assert r["chaos_plan"] == plan, r
+    for kind in ("transient", "hang", "latency", "crash_mid"):
+        assert r["faults_injected"].get(kind, 0) >= 1, \
+            (kind, r["faults_injected"])
+    # Deterministic replay: the same plan bites identically twice.
+    assert colo[0]["faults_injected"] == colo[1]["faults_injected"]
+
+    # -- disaggregated handoff faults: corruption + loss, both rejected
+    plan = os.path.join(PLAN_DIR, "fleet_disagg.json")
+    dis = [run_fleet_bench(smoke=True, trace=trace,
+                           prefill_replicas=1, decode_replicas=1,
+                           chaos_plan=plan)
+           for _ in range(2)]
+    r = dis[0]
+    _assert_contract(r, "disagg")
+    for kind in ("corrupt", "drop"):
+        assert r["faults_injected"].get(kind, 0) >= 1, \
+            (kind, r["faults_injected"])
+    assert dis[0]["faults_injected"] == dis[1]["faults_injected"]
+
+    # -- brownout: engage AND recover under the prefill-heavy adversary.
+    # The smoke fleet is tiny, so the gate hands the controller a
+    # pressure-sensitive policy — the LEVELS and their knobs are the
+    # production ones, only the thresholds are scaled to smoke depth.
+    from deeplearning_cfn_tpu.fleet.degrade import DegradePolicy
+
+    def _policy():
+        return DegradePolicy(up_queue_depth=0.5, down_queue_depth=0.25,
+                             up_stable_ticks=1, down_stable_ticks=1,
+                             cooldown_ticks=0)
+
+    deg = [run_fleet_bench(smoke=True, trace_mix="prefill-heavy",
+                           decode_window=1, degrade=True,
+                           degrade_policy=_policy())
+           for _ in range(2)]
+    r = deg[0]
+    _assert_contract(r, "degrade")
+    actions = [e["action"] for e in r["degrade_events"]]
+    assert "degrade" in actions, r["degrade_events"]
+    assert "recover" in actions, r["degrade_events"]
+    assert r["degrade_events"][-1]["level"] == 0, r["degrade_events"]
+    assert [e["action"] for e in deg[1]["degrade_events"]] == actions
+
+    print(f"CHAOS_FLEET_SMOKE=OK "
+          f"colocated_faults={colo[0]['faults_injected']} "
+          f"disagg_faults={dis[0]['faults_injected']} "
+          f"degrade_transitions={deg[0]['degrade_transitions']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
