@@ -230,7 +230,10 @@ def _finalize_green(record: dict, alive: bool, probe_note: str,
                     "chunk_ticks_per_prefill_p50",
                     "chaos_plan", "faults_injected",
                     "degrade_transitions", "degrade_events",
-                    "deadline_wasted_tokens"):
+                    "deadline_wasted_tokens",
+                    "net_decode_p95_disagg", "net_decode_p95_colocated",
+                    "autoscale_time_to_scale_s",
+                    "net_stream_ttfb_p50", "net_stream_ttfb_p95"):
             if key in record:
                 record[key] = None
     return record

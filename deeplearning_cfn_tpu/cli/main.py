@@ -17,6 +17,7 @@ import argparse
 import json
 import os
 import sys
+import time
 from typing import List, Optional
 
 from ..config import ExperimentConfig, StackConfig, apply_overrides
@@ -373,6 +374,10 @@ def _cmd_bench(args) -> int:
               "already split prefill off the decode tick (drop "
               "--fleet-prefill/--fleet-decode)", file=sys.stderr)
         return 2
+    if getattr(args, "net", False) and not getattr(args, "fleet", False):
+        print("[dlcfn-tpu] --net is a fleet-scenario flag — pass it "
+              "with --fleet", file=sys.stderr)
+        return 2
     if getattr(args, "fleet", False):
         if getattr(args, "ops", None) or args.collectives or \
                 getattr(args, "sweep_batches", None) or \
@@ -381,6 +386,38 @@ def _cmd_bench(args) -> int:
                   "with --serve/--ops/--collectives/--sweep-batches",
                   file=sys.stderr)
             return 2
+        if getattr(args, "net", False):
+            # Real child processes over unix sockets — the wall-clock
+            # fleet record (bench --fleet without --net stays the
+            # in-process simulation).
+            if getattr(args, "trace", None) or args.chaos_plan or \
+                    args.degrade or args.radix_cache or \
+                    getattr(args, "prefill_chunk", 0) or \
+                    args.trace_mix != "uniform":
+                print("[dlcfn-tpu] --net runs the process-fleet record "
+                      "— --trace/--trace-mix/--chaos-plan/--degrade/"
+                      "--radix-cache/--prefill-chunk are in-process "
+                      "scenario flags", file=sys.stderr)
+                return 2
+            import tempfile
+
+            from ..net.bench import run_net_fleet_bench
+
+            run_root = tempfile.mkdtemp(prefix="dlcfn-netbench-")
+            line = run_net_fleet_bench(
+                run_root,
+                smoke=args.smoke,
+                replicas=args.fleet_replicas,
+                num_requests=args.requests_count,
+                slots=args.slots,
+                decode_window=args.decode_window,
+                policy=args.fleet_policy,
+                disagg=True,
+                chaos_kill=bool(args.fleet_chaos_step),
+                autoscale=args.autoscale,
+                trace_dir=args.fleet_trace_dir or "")
+            print(json.dumps(line))
+            return 0
         if getattr(args, "autoscale", False) and not args.trace:
             print("[dlcfn-tpu] --autoscale needs --trace (the controller "
                   "runs on the open-loop replay clock)", file=sys.stderr)
@@ -759,8 +796,11 @@ def _fleet_route_trace(router, trace, args):
         kwargs = dict(
             max_new_tokens=int(rec.get("max_new_tokens",
                                        args.max_new_tokens)),
+            beam_size=int(rec.get("beam_size", 1)),
             request_id=rec.get("id"),
         )
+        if rec.get("deadline_s") is not None:
+            kwargs["deadline_s"] = float(rec["deadline_s"])
         # Per-request QoS tags ride in the trace line itself
         # ({"tenant": ..., "qos_class": ...}); untagged lines keep the
         # exact pre-QoS submit shape.
@@ -872,6 +912,122 @@ def _fleet_up_disagg(args) -> int:
     return 0 if stats["dropped_requests"] == 0 else 1
 
 
+def _fleet_up_net(args) -> int:
+    """--net: `fleet up` over REAL socket-backed replica servers
+    (``python -m deeplearning_cfn_tpu.net.server``), each spawned
+    through a :class:`SupervisedSpawner` spec factory so every replica
+    carries the launcher's hang-vs-crash restart budget and its own
+    ``logs/launch.jsonl`` stream, then driven by the NetRouter over
+    unix sockets. The children serve the seeded tiny-NMT recipe engine
+    (not a preset checkpoint), so the trace must stay inside its
+    vocab; prints one JSON result line per request like `fleet
+    route`, and the per-replica run dirs feed `fleet status`."""
+    from ..fleet.autoscale import SupervisedSpawner
+    from ..net.bench import make_server_spec
+    from ..net.client import RemoteReplica
+    from ..net.router import NetRouter
+    from ..net.server import TINY_VOCAB
+    from ..serve import OverloadError
+
+    cfg = apply_overrides(get_preset(args.preset), args.overrides)
+    run_root = args.run_root or os.path.join(
+        cfg.workdir, args.preset, "fleet")
+    os.makedirs(run_root, exist_ok=True)
+    try:
+        trace, bpe = _fleet_read_trace(args.requests, args.vocab)
+    except (OSError, ValueError) as e:
+        print(f"[dlcfn-tpu] ERROR: {e}", file=sys.stderr)
+        return 1
+    for item in trace:
+        bad = [t for t in item["src_ids"]
+               if t < 0 or t >= TINY_VOCAB]
+        if bad:
+            print(f"[dlcfn-tpu] ERROR: --net replicas serve the seeded "
+                  f"tiny-NMT recipe (vocab {TINY_VOCAB}); request "
+                  f"{item['rec'].get('id', '?')} has out-of-range "
+                  f"token ids {bad[:4]}", file=sys.stderr)
+            return 1
+    warmup = trace[0]["src_ids"] if trace else ()
+    src_len = max((len(item["src_ids"]) for item in trace), default=8)
+
+    def spec_factory(phase, replica_id):
+        run_dir = os.path.join(run_root, replica_id)
+        os.makedirs(run_dir, exist_ok=True)
+        spec, _ = make_server_spec(
+            replica_id, run_dir, phase=phase, slots=args.slots,
+            src_len=src_len, max_new_tokens=args.max_new_tokens,
+            decode_window=args.decode_window, warmup_src=warmup,
+            trace=True)
+        return spec
+
+    def replica_factory(phase, replica_id):
+        addr = "unix://" + os.path.join(
+            run_root, replica_id, "replica.sock")
+        return RemoteReplica(replica_id, addr, phase=phase,
+                             connect_retry_deadline_s=180.0)
+
+    spawner = SupervisedSpawner(spec_factory, replica_factory,
+                                max_restarts=args.max_restarts)
+
+    class _PollAll:
+        # NetRouter polls one supervisor per tick; the spawner holds
+        # one single-spec supervisor per replica.
+        def poll(self):
+            for sup in spawner.supervisors.values():
+                sup.poll()
+
+    print(f"[dlcfn-tpu] fleet up --net: {args.replicas} replica "
+          f"process(es), {len(trace)} request(s), run root {run_root}",
+          file=sys.stderr)
+    replicas = []
+    try:
+        for i in range(args.replicas):
+            replicas.append(spawner.spawn("both", f"replica-{i}"))
+        for r in replicas:
+            r.connect()   # readiness barrier: built + warm
+        router = NetRouter(replicas, supervisor=_PollAll(),
+                           policy=args.policy)
+        rids = []
+        for item in trace:
+            rec = item["rec"]
+            kwargs = dict(
+                max_new_tokens=int(rec.get("max_new_tokens",
+                                           args.max_new_tokens)),
+                beam_size=int(rec.get("beam_size", 1)),
+                request_id=rec.get("id"))
+            if rec.get("deadline_s") is not None:
+                kwargs["deadline_s"] = float(rec["deadline_s"])
+            for key in ("tenant", "qos_class"):
+                if rec.get(key) is not None:
+                    kwargs[key] = str(rec[key])
+            while True:
+                try:
+                    rids.append(router.submit(item["src_ids"],
+                                              **kwargs))
+                    break
+                except OverloadError:
+                    # Remote children drain between ticks — zero
+                    # observed progress is normal, not terminal.
+                    router.step()
+                    time.sleep(0.01)
+        router.run_until_drained(
+            idle_timeout_s=max(args.timeout, 60.0))
+        _fleet_print_results(router, rids, bpe)
+        for r in replicas:
+            try:
+                r.drain()
+            except Exception:
+                pass
+        dropped = router.dropped_requests
+        print(f"[dlcfn-tpu] fleet up --net drained: "
+              f"dropped_requests={dropped}", file=sys.stderr)
+        return 0 if dropped == 0 else 1
+    finally:
+        for r in replicas:
+            r.close()
+        spawner.close()
+
+
 def _cmd_fleet_up(args) -> int:
     """Run N serve child processes over a sharded request trace, each in
     its own run dir under --run-root, supervised with hang-vs-crash
@@ -881,6 +1037,14 @@ def _cmd_fleet_up(args) -> int:
     from ..fleet import ReplicaProcSpec, ReplicaSupervisor
     from ..obs.report import render_fleet_report, summarize_fleet
 
+    if getattr(args, "net", False):
+        if getattr(args, "prefill", 0) or getattr(args, "decode", 0):
+            print("[dlcfn-tpu] --net spawns co-located server "
+                  "processes — drop --prefill/--decode (the process "
+                  "fleet's disagg topology lives in `bench --fleet "
+                  "--net`)", file=sys.stderr)
+            return 2
+        return _fleet_up_net(args)
     if getattr(args, "prefill", 0) or getattr(args, "decode", 0):
         return _fleet_up_disagg(args)
     cfg = apply_overrides(get_preset(args.preset), args.overrides)
@@ -1781,6 +1945,15 @@ def build_parser() -> argparse.ArgumentParser:
                       help="fleet run root; per-replica run dirs are "
                            "created under it (default: <workdir>/<preset>"
                            "/fleet)")
+    flup.add_argument("--net", action="store_true",
+                      help="socket fleet: replica SERVER processes "
+                           "(net/server.py children behind unix "
+                           "sockets) spawned through SupervisedSpawner "
+                           "spec factories and driven by the NetRouter "
+                           "— requests stream over the wire instead of "
+                           "being sharded into files; children serve "
+                           "the seeded tiny-NMT recipe engine, so the "
+                           "trace must stay inside its vocab")
     flup.add_argument("--max-restarts", type=int, default=1,
                       help="per-replica restart budget on hang/crash "
                            "(default 1)")
@@ -1938,6 +2111,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "in-process engine replicas; reports aggregate "
                          "tokens/sec, per-replica utilization, and the "
                          "zero-drop contract (dropped_requests)")
+    be.add_argument("--net", action="store_true",
+                    help="fleet scenario: REAL child-process replicas "
+                         "over unix sockets behind the network front "
+                         "door (tiny-NMT recipe engines) — the record "
+                         "gains wall-clock net_decode_p95_disagg vs "
+                         "_colocated, net_stream_ttfb_p50/p95 measured "
+                         "client-side, and (with --autoscale) "
+                         "autoscale_time_to_scale_s including process "
+                         "fork + model build + warmup; "
+                         "--fleet-chaos-step N (any N > 0) SIGKILLs a "
+                         "replica mid-stream and asserts the zero-drop "
+                         "contract")
     be.add_argument("--fleet-replicas", type=int, default=2,
                     help="fleet scenario: replica count (default 2)")
     be.add_argument("--fleet-prefill", type=int, default=0,

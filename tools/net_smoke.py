@@ -1,0 +1,150 @@
+"""NET smoke gate — run by tools/t1.sh.
+
+Drives a 2-replica PROCESS fleet (real serve-engine child processes
+behind unix-domain sockets) over a trace derived from the wmt_sliver
+fixture and asserts the promotion-to-processes contract end to end:
+
+- zero dropped requests, with cross-process token output identical to
+  the in-process fleet on the same seeded trace,
+- a replica SIGKILL'd mid-stream is evacuated (zero drops), restarted
+  by the supervisor, and READMITTED over its re-bound socket — after
+  which it serves again,
+- the merged Perfetto export still links cross-process flows: at least
+  one trace_id has spans on more than one OS process.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning_cfn_tpu.fleet.replica import ReplicaState
+from deeplearning_cfn_tpu.fleet.router import (
+    FleetOverloadError,
+    NoReplicasError,
+)
+from deeplearning_cfn_tpu.metrics.jsonl import MetricsWriter
+from deeplearning_cfn_tpu.net.bench import (
+    _reference_tokens,
+    _teardown,
+    spawn_process_fleet,
+)
+from deeplearning_cfn_tpu.net.router import NetRouter
+from deeplearning_cfn_tpu.obs.export import export_fleet_trace
+from deeplearning_cfn_tpu.obs.sinks import JsonlSink
+from deeplearning_cfn_tpu.serve.queue import OverloadError
+
+GEOMETRY = dict(slots=2, src_len=8, max_new_tokens=4, queue_depth=16,
+                decode_window=4, seed=0)
+
+
+def _submit(rt, trace, prefix, max_new_tokens):
+    rids = []
+    for i, src in enumerate(trace):
+        while True:
+            try:
+                rids.append(rt.submit(src, max_new_tokens=max_new_tokens,
+                                      request_id=f"{prefix}{i}"))
+                break
+            except (FleetOverloadError, OverloadError, NoReplicasError):
+                rt.step()
+                time.sleep(0.01)
+    return rids
+
+
+def main() -> int:
+    sliver = os.path.join("tests", "data", "wmt_sliver.de")
+    with open(sliver, "rb") as fh:
+        lines = [ln for ln in fh.read().splitlines() if ln.strip()]
+    trace = [[3 + (b % 93) for b in ln[:8]] for ln in lines][:6]
+    assert len(trace) >= 2, "wmt_sliver fixture too small for the gate"
+
+    with tempfile.TemporaryDirectory() as root:
+        sup, remotes = spawn_process_fleet(
+            root, ["both", "both"], trace=True, max_restarts=1,
+            warmup_src=trace[0], **GEOMETRY)
+        router_writer = MetricsWriter(
+            os.path.join(root, "router.jsonl"), also_stdout=False)
+        try:
+            rt = NetRouter(remotes, supervisor=sup)
+            rt.trace_sink = JsonlSink(router_writer)
+            for r in remotes:
+                r.trace_sink = JsonlSink(MetricsWriter(
+                    os.path.join(root, r.id, "client.jsonl"),
+                    also_stdout=False))
+
+            # -- phase A: cross-process token parity, zero drops ------
+            rids = _submit(rt, trace, "q", GEOMETRY["max_new_tokens"])
+            rt.run_until_drained(idle_timeout_s=60.0)
+            assert rt.dropped_requests == 0, rt.stats()
+            got = {rid: list(rt.result(rid)["tokens"]) for rid in rids}
+            ref = _reference_tokens(
+                trace, GEOMETRY["max_new_tokens"], 1,
+                slots=GEOMETRY["slots"], src_len=GEOMETRY["src_len"],
+                queue_depth=GEOMETRY["queue_depth"],
+                decode_window=GEOMETRY["decode_window"],
+                seed=GEOMETRY["seed"])
+            assert got == ref, {"got": got, "ref": ref}
+
+            # -- phase B: SIGKILL mid-stream → evacuate, zero drops ---
+            rids_b = _submit(rt, trace, "k", 8)
+            sup._replicas[1].handle._procs[0].proc.kill()
+            rt.run_until_drained(idle_timeout_s=60.0)
+            assert rt.dropped_requests == 0, rt.stats()
+            assert all(rt.result(rid)["state"] == "done"
+                       for rid in rids_b), [rt.result(r) for r in rids_b]
+
+            # -- phase C: supervisor restart → socket readmission -----
+            # Wait for the condition we assert: readmitted AND currently
+            # healthy. A readmission can flap (reconnect verified, then
+            # the next RPC finds the child mid-restart) — the contract
+            # is that tending CONVERGES, not that it never retries.
+            deadline = time.monotonic() + 120.0
+            while (rt.reconnects < 1
+                   or remotes[1].state is not ReplicaState.HEALTHY) \
+                    and time.monotonic() < deadline:
+                rt.step()
+                time.sleep(0.05)
+            assert rt.reconnects >= 1, "restarted replica never readmitted"
+            assert remotes[1].state is ReplicaState.HEALTHY, \
+                remotes[1].state
+            rids_c = _submit(rt, trace[:2], "p",
+                             GEOMETRY["max_new_tokens"])
+            rt.run_until_drained(idle_timeout_s=60.0)
+            assert rt.dropped_requests == 0, rt.stats()
+            evacuations = rt.stats()["evacuations"]
+            reconnects = rt.reconnects
+            assert len(rids_c) == 2
+        finally:
+            _teardown(sup, remotes)
+            router_writer.close()
+
+        # -- merged Perfetto export: flows still cross processes ------
+        out = os.path.join(root, "trace.json")
+        s = export_fleet_trace(root, out)
+        assert not s["problems"], s
+        assert s["flow_events"] >= 1, s
+        with open(out) as fh:
+            events = json.load(fh)["traceEvents"]
+        by_trace = {}
+        for e in events:
+            if e.get("ph") != "X":
+                continue
+            tid = (e.get("args") or {}).get("trace_id")
+            if isinstance(tid, str):
+                by_trace.setdefault(tid, set()).add(e.get("pid"))
+        crossed = [t for t, pids in by_trace.items() if len(pids) > 1]
+        assert crossed, {t: sorted(p) for t, p in by_trace.items()}
+
+    print(f"NET_SMOKE=OK parity_requests={len(trace)} "
+          f"evacuations={evacuations} reconnects={reconnects} "
+          f"flow_events={s['flow_events']} "
+          f"cross_process_traces={len(crossed)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
